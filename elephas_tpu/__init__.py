@@ -1,0 +1,45 @@
+"""elephas_tpu — TPU-native distributed deep learning for Keras.
+
+A from-scratch rebuild of the capabilities of the `elephas` reference
+(Keras-on-Spark data-parallel training; see SURVEY.md) designed TPU-first
+on JAX/XLA:
+
+- Per-worker TensorFlow/CUDA execution becomes a single ``jax.jit``-compiled
+  Keras-3 (jax backend) train program per epoch, sharded over a
+  ``jax.sharding.Mesh`` worker axis via ``shard_map`` — zero Python in the
+  hot loop.
+- The reference's pickle-over-HTTP/TCP parameter server
+  (``[U] elephas/parameter/``) is replaced in the hot path by XLA
+  collectives (``lax.pmean``) over ICI/DCN. Parameter-server classes are
+  still provided (``elephas_tpu.parameter``) for API parity and for
+  cross-host weight stores over DCN.
+- RDD partitions (``[U] elephas/utils/rdd_utils.py``) map onto mesh workers;
+  a lightweight ``SparkContext``/``Rdd`` shim supplies the reference's data
+  API without a JVM.
+
+Public surface mirrors the reference (``[U] elephas/spark_model.py``,
+``ml_model.py``, ``hyperparam.py``): ``SparkModel`` and ``SparkMLlibModel``
+here; ``ElephasEstimator``/``ElephasTransformer`` in
+``elephas_tpu.ml_model`` and ``HyperParamModel`` in
+``elephas_tpu.hyperparam``.
+"""
+
+import os
+
+# Keras must run on the jax backend before anything imports keras.
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+__version__ = "0.1.0"
+
+from elephas_tpu.spark_model import (  # noqa: E402,F401
+    SparkModel,
+    SparkMLlibModel,
+    load_spark_model,
+)
+
+__all__ = [
+    "SparkModel",
+    "SparkMLlibModel",
+    "load_spark_model",
+    "__version__",
+]

@@ -1,0 +1,19 @@
+"""Data layer: the distribution-platform shim.
+
+The reference delegates data distribution to Apache Spark (SparkContext,
+RDDs, DataFrames — SURVEY.md §1 L0a). On TPU there is no JVM: partitions
+are host-local shards that map 1:1 onto mesh workers. This package supplies
+API-compatible stand-ins — ``SparkContext``, ``Rdd``, ``Broadcast``, and
+the MLlib linalg types — that are deliberately small: they exist so
+reference code ports unchanged, while all heavy lifting happens in jitted
+XLA programs.
+"""
+
+from elephas_tpu.data.context import SparkContext, Broadcast  # noqa: F401
+from elephas_tpu.data.rdd import Rdd  # noqa: F401
+from elephas_tpu.data.linalg import (  # noqa: F401
+    DenseVector,
+    DenseMatrix,
+    LabeledPoint,
+    Vectors,
+)

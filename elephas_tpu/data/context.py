@@ -1,0 +1,81 @@
+"""SparkContext shim — entry point of the data layer.
+
+The reference's driver is a JVM ``SparkContext`` reached over py4j
+(SURVEY.md §1 L0a). Here the "cluster" is the TPU mesh; the context only
+creates partitioned host datasets (:class:`~elephas_tpu.data.rdd.Rdd`) and
+broadcasts (plain host references — on TPU, replication to devices is
+XLA's job via shardings, not the data layer's).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable
+
+
+class Broadcast:
+    """Driver-held broadcast variable (``sc.broadcast(v).value``)."""
+
+    def __init__(self, value: Any):
+        self.value = value
+
+    def unpersist(self) -> None:
+        pass
+
+    def destroy(self) -> None:
+        self.value = None
+
+
+class SparkContext:
+    """Local stand-in for ``pyspark.SparkContext``.
+
+    ``master='local[N]'`` sets the default parallelism N (``local[*]`` uses
+    the number of visible JAX devices — the natural TPU analogue of "all
+    cores").
+    """
+
+    def __init__(self, master: str = "local[*]", appName: str = "elephas_tpu"):
+        self.master = master
+        self.appName = appName
+        self._default_parallelism = self._parse_master(master)
+
+    @staticmethod
+    def _parse_master(master: str) -> int:
+        m = re.fullmatch(r"local\[(\*|\d+)\]", master)
+        if m is None:
+            if master == "local":
+                return 1
+            raise ValueError(
+                f"unsupported master {master!r}; this shim is local-only "
+                "(cluster scale-out rides the TPU mesh, not the data layer)"
+            )
+        if m.group(1) == "*":
+            import jax
+
+            return max(1, len(jax.devices()))
+        return max(1, int(m.group(1)))
+
+    @property
+    def defaultParallelism(self) -> int:
+        return self._default_parallelism
+
+    def parallelize(self, data: Iterable[Any], numSlices: int | None = None):
+        from elephas_tpu.data.rdd import Rdd
+
+        elements = list(data)
+        n = numSlices or min(self._default_parallelism, max(1, len(elements)))
+        n = max(1, n)
+        # Contiguous split (Spark semantics), sizes differing by at most 1.
+        base, rem = divmod(len(elements), n)
+        parts, start = [], 0
+        for i in range(n):
+            size = base + (1 if i < rem else 0)
+            parts.append(elements[start : start + size])
+            start += size
+        return Rdd(parts)
+
+    def broadcast(self, value: Any) -> Broadcast:
+        return Broadcast(value)
+
+    def stop(self) -> None:
+        pass

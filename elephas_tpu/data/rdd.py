@@ -1,0 +1,95 @@
+"""Rdd — a host-local, partitioned dataset with the Spark RDD surface.
+
+The reference trains on ``pyspark.RDD``s whose partitions Spark ships to
+executors (``rdd.mapPartitions(worker.train)``, SURVEY.md §3.1). Here a
+partition is simply a list of elements held on the host; ``SparkModel``
+maps partitions onto TPU mesh workers and stacks them into device arrays.
+
+Only the API surface the reference exercises is implemented:
+``mapPartitions``, ``map``, ``filter``, ``collect``, ``repartition``,
+``getNumPartitions``, ``count``, ``first``, ``take``, ``cache``,
+``unpersist``, ``zip``. Everything is eager (no DAG) — laziness buys
+nothing when the compute path is XLA.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator
+
+
+class Rdd:
+    def __init__(self, partitions: list[list[Any]]):
+        self._partitions = [list(p) for p in partitions]
+
+    # -- structure -----------------------------------------------------
+
+    def getNumPartitions(self) -> int:
+        return len(self._partitions)
+
+    def repartition(self, num_partitions: int) -> "Rdd":
+        """Round-robin redistribute elements into ``num_partitions``."""
+        if num_partitions <= 0:
+            raise ValueError("num_partitions must be positive")
+        parts: list[list[Any]] = [[] for _ in range(num_partitions)]
+        for i, el in enumerate(self._iter_all()):
+            parts[i % num_partitions].append(el)
+        return Rdd(parts)
+
+    coalesce = repartition
+
+    def partitions(self) -> list[list[Any]]:
+        """Direct partition access (not in Spark's API; used internally)."""
+        return self._partitions
+
+    # -- transformations ----------------------------------------------
+
+    def map(self, f: Callable[[Any], Any]) -> "Rdd":
+        return Rdd([[f(el) for el in p] for p in self._partitions])
+
+    def filter(self, f: Callable[[Any], bool]) -> "Rdd":
+        return Rdd([[el for el in p if f(el)] for p in self._partitions])
+
+    def mapPartitions(self, f: Callable[[Iterator[Any]], Iterable[Any]]) -> "Rdd":
+        return Rdd([list(f(iter(p))) for p in self._partitions])
+
+    def zip(self, other: "Rdd") -> "Rdd":
+        if self.getNumPartitions() != other.getNumPartitions():
+            raise ValueError("zip: partition counts differ")
+        return Rdd(
+            [
+                list(zip(a, b, strict=True))
+                for a, b in zip(self._partitions, other._partitions)
+            ]
+        )
+
+    # -- actions -------------------------------------------------------
+
+    def collect(self) -> list[Any]:
+        return list(self._iter_all())
+
+    def count(self) -> int:
+        return sum(len(p) for p in self._partitions)
+
+    def first(self) -> Any:
+        for el in self._iter_all():
+            return el
+        raise ValueError("first() on empty RDD")
+
+    def take(self, n: int) -> list[Any]:
+        return list(itertools.islice(self._iter_all(), n))
+
+    # -- persistence (no-ops: data is already host-resident) -----------
+
+    def cache(self) -> "Rdd":
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "Rdd":
+        return self
+
+    # -- internal ------------------------------------------------------
+
+    def _iter_all(self) -> Iterator[Any]:
+        return itertools.chain.from_iterable(self._partitions)

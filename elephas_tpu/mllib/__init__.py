@@ -1,0 +1,8 @@
+"""MLlib compatibility layer (``[U] elephas/mllib/``)."""
+
+from elephas_tpu.mllib.adapter import (  # noqa: F401
+    to_matrix,
+    from_matrix,
+    to_vector,
+    from_vector,
+)

@@ -1,0 +1,10 @@
+"""Parallelism layer: device mesh construction and synchronization modes.
+
+The reference's distribution platform is Spark's scheduler + a
+pickle-over-HTTP/TCP parameter server (SURVEY.md §2b). Here the platform
+is a ``jax.sharding.Mesh``: worker data-parallelism over a ``'workers'``
+axis, weight synchronization via XLA collectives compiled into the train
+program, riding ICI within a slice and DCN across slices.
+"""
+
+from elephas_tpu.parallel.mesh import worker_mesh, num_available_workers  # noqa: F401

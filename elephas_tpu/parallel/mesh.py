@@ -1,0 +1,43 @@
+"""Worker mesh construction — the SparkContext/executor-pool analogue.
+
+The reference asks Spark for ``num_workers`` executors and repartitions
+RDDs to match (``[U] elephas/spark_model.py::SparkModel.fit``). Here the
+executor pool is the set of addressable JAX devices; a 1-D
+``Mesh(devices[:W], ('workers',))`` fixes the data-parallel axis. Requests
+for more workers than devices clamp (with a warning) — TPU topology is
+physical, unlike Spark's oversubscribable task slots.
+
+Multi-host: ``jax.devices()`` spans all processes after
+``jax.distributed.initialize``; the same mesh construction then yields a
+cross-host DP axis whose collectives ride ICI within a slice and DCN
+across slices — XLA picks the transport, this module never needs to know.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+
+def num_available_workers() -> int:
+    return len(jax.devices())
+
+
+def worker_mesh(num_workers: int | None = None) -> Mesh:
+    """Build a 1-D ``('workers',)`` mesh over up to ``num_workers`` devices."""
+    devices = jax.devices()
+    if num_workers is None or num_workers <= 0:
+        num_workers = len(devices)
+    if num_workers > len(devices):
+        logger.warning(
+            "requested %d workers but only %d devices are addressable; "
+            "clamping (mesh workers are physical devices, not task slots)",
+            num_workers,
+            len(devices),
+        )
+        num_workers = len(devices)
+    return Mesh(devices[:num_workers], ("workers",))

@@ -1,0 +1,23 @@
+"""Parameter server & client (``[U] elephas/parameter/``).
+
+In the reference these carry the entire asynchronous training protocol:
+workers pull weights and push deltas over Flask HTTP or raw TCP, full
+model bytes pickled per round-trip — the main scalability cliff of the
+design (SURVEY.md §3.2).
+
+In the TPU rebuild the hot path is in-XLA collectives; these classes
+remain for (a) API parity, (b) a coordinator-hosted weight store over DCN
+for external pollers / cross-job consumers, and (c) faithful unit-testable
+semantics of the async/hogwild locking difference.
+"""
+
+from elephas_tpu.parameter.server import (  # noqa: F401
+    BaseParameterServer,
+    HttpServer,
+    SocketServer,
+)
+from elephas_tpu.parameter.client import (  # noqa: F401
+    BaseParameterClient,
+    HttpClient,
+    SocketClient,
+)

@@ -1,0 +1,68 @@
+"""Parameter clients — worker-side counterparts of the servers.
+
+Reference surface: ``[U] elephas/parameter/client.py`` —
+``BaseParameterClient`` with ``get_parameters()`` / ``update_parameters``;
+``HttpClient`` over urllib, ``SocketClient`` over raw TCP.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import urllib.request
+
+from elephas_tpu.utils import sockets
+
+
+class BaseParameterClient:
+    def get_parameters(self):
+        raise NotImplementedError
+
+    def update_parameters(self, delta) -> None:
+        raise NotImplementedError
+
+
+class HttpClient(BaseParameterClient):
+    def __init__(self, master: str | None = None, port: int = 4000):
+        master = master or sockets.determine_master(port)
+        if not master.startswith("http"):
+            master = "http://" + master
+        self.master_url = master
+
+    def get_parameters(self):
+        with urllib.request.urlopen(self.master_url + "/parameters") as r:
+            return pickle.loads(r.read())
+
+    def update_parameters(self, delta) -> None:
+        payload = pickle.dumps(delta)
+        req = urllib.request.Request(
+            self.master_url + "/update",
+            data=payload,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST",
+        )
+        urllib.request.urlopen(req).read()
+
+
+class SocketClient(BaseParameterClient):
+    def __init__(self, master: str | None = None, port: int = 4000):
+        master = master or sockets.determine_master(port)
+        host, _, p = master.partition(":")
+        self.host = host
+        self.port = int(p or port)
+        self._sock = socket.create_connection((self.host, self.port))
+
+    def get_parameters(self):
+        self._sock.sendall(b"g")
+        return sockets.receive(self._sock)
+
+    def update_parameters(self, delta) -> None:
+        self._sock.sendall(b"u")
+        sockets.send(self._sock, delta)
+
+    def close(self) -> None:
+        try:
+            self._sock.sendall(b"q")
+        except OSError:
+            pass
+        self._sock.close()

@@ -1,0 +1,168 @@
+"""Parameter servers: HTTP and raw-socket weight stores.
+
+Reference surface: ``[U] elephas/parameter/server.py`` — ``HttpServer``
+(Flask app in a daemon thread; ``GET /parameters`` → pickled weights,
+``POST /update`` → apply delta, with a ``threading.Lock`` iff
+mode='asynchronous' and lock-free for 'hogwild' — that lock is the entire
+difference between the modes) and ``SocketServer`` (TCP op-code protocol).
+
+Rebuilt on the stdlib (`http.server`, `socketserver`) — Flask is not a
+dependency. Payloads are pickled numpy weight lists, same wire idea as the
+reference; do not expose these ports to untrusted networks (pickle).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from elephas_tpu.utils import sockets
+from elephas_tpu.utils.functional_utils import add_params
+
+
+class BaseParameterServer:
+    """Holds the mutable master weight list.
+
+    ``mode='asynchronous'`` serializes updates under a lock;
+    ``mode='hogwild'`` applies them lock-free (torn reads/writes are
+    accepted, as in the reference).
+    """
+
+    def __init__(self, weights, mode: str = "asynchronous", port: int = 4000):
+        self.mode = mode
+        self.port = port
+        self.lock = threading.Lock()
+        self.weights = [np.asarray(w) for w in weights]
+        self._started = False
+
+    # -- weight store --------------------------------------------------
+
+    def get_parameters(self) -> list[np.ndarray]:
+        if self.mode == "asynchronous":
+            with self.lock:
+                return [w.copy() for w in self.weights]
+        return [w.copy() for w in self.weights]
+
+    def update_parameters(self, delta) -> None:
+        if self.mode == "asynchronous":
+            with self.lock:
+                self.weights = add_params(self.weights, delta)
+        else:  # hogwild: deliberately lock-free
+            self.weights = add_params(self.weights, delta)
+
+    def set_weights(self, weights) -> None:
+        with self.lock:
+            self.weights = [np.asarray(w) for w in weights]
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        raise NotImplementedError
+
+
+class HttpServer(BaseParameterServer):
+    """``GET /parameters`` / ``POST /update`` over stdlib HTTP."""
+
+    def __init__(self, weights, mode: str = "asynchronous", port: int = 4000):
+        super().__init__(weights, mode, port)
+        self._httpd = None
+        self._thread = None
+
+    def start(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence request logging
+                pass
+
+            def do_GET(self):
+                if self.path != "/parameters":
+                    self.send_error(404)
+                    return
+                payload = pickle.dumps(server.get_parameters())
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_POST(self):
+                if self.path != "/update":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                delta = pickle.loads(self.rfile.read(length))
+                server.update_parameters(delta)
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolves port=0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self._started = True
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._started = False
+
+
+class SocketServer(BaseParameterServer):
+    """Raw-TCP op-code protocol: ``b'g'`` get, ``b'u'`` update, ``b'q'`` bye.
+
+    Frames are length-prefixed pickles (:mod:`elephas_tpu.utils.sockets`).
+    """
+
+    def __init__(self, weights, mode: str = "asynchronous", port: int = 4000):
+        super().__init__(weights, mode, port)
+        self._server = None
+        self._thread = None
+
+    def start(self) -> None:
+        ps = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    op = self.request.recv(1)
+                    if not op or op == b"q":
+                        return
+                    if op == b"g":
+                        sockets.send(self.request, ps.get_parameters())
+                    elif op == b"u":
+                        delta = sockets.receive(self.request)
+                        ps.update_parameters(delta)
+                    else:
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server(("0.0.0.0", self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self._started = True
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            self._started = False

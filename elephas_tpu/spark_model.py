@@ -1,0 +1,292 @@
+"""SparkModel — the master-side façade for data-parallel Keras training.
+
+Reference surface: ``[U] elephas/spark_model.py`` — ``SparkModel``,
+``SparkMLlibModel``, ``load_spark_model`` (SURVEY.md §2, §3.1–3.4). The
+constructor/kwarg surface is the parity contract: ``SparkModel(model,
+mode=, frequency=, parameter_server_mode=, num_workers=, custom_objects=,
+batch_size=, port=)`` with ``.fit/.predict/.evaluate/.save`` and a
+``master_network`` property.
+
+TPU-first redesign: ``fit`` does not ship pickled closures to executors.
+It maps RDD partitions onto a ``('workers',)`` device mesh and runs the
+whole training loop as compiled XLA programs (see
+:mod:`elephas_tpu.worker`). ``parameter_server_mode`` is accepted for
+parity: when set, an actual HTTP/TCP weight store is started on the driver
+(``elephas_tpu.parameter``) and kept in sync at epoch boundaries so
+external observers (dashboards, cross-host pollers) see live weights —
+but the hot-path synchronization is always in-XLA collectives, never
+pickle round-trips.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import numpy as np
+
+from elephas_tpu.data.rdd import Rdd
+from elephas_tpu.parallel.mesh import worker_mesh
+from elephas_tpu.utils import rdd_utils
+from elephas_tpu.worker import MeshRunner, MODES, FREQUENCIES
+
+logger = logging.getLogger(__name__)
+
+
+class SparkModel:
+    def __init__(
+        self,
+        model,
+        mode: str = "synchronous",
+        frequency: str = "epoch",
+        parameter_server_mode: str | None = None,
+        num_workers: int | None = None,
+        custom_objects: dict | None = None,
+        batch_size: int = 32,
+        port: int = 4000,
+        *args,
+        **kwargs,
+    ):
+        import keras
+
+        if not isinstance(model, keras.Model):
+            raise ValueError(f"model must be a keras.Model, got {type(model)}")
+        if getattr(model, "optimizer", None) is None:
+            raise ValueError(
+                "model must be compiled (optimizer/loss/metrics) before "
+                "wrapping in SparkModel — same contract as the reference"
+            )
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        if frequency not in FREQUENCIES:
+            raise ValueError(
+                f"frequency must be one of {FREQUENCIES}, got {frequency!r}"
+            )
+
+        self._master_network = model
+        self.mode = mode
+        self.frequency = frequency
+        self.parameter_server_mode = parameter_server_mode
+        self.custom_objects = custom_objects
+        self.batch_size = batch_size
+        self.port = port
+        self.kwargs = kwargs
+
+        self.mesh = worker_mesh(num_workers)
+        self.num_workers = self.mesh.devices.size
+        self._runner: MeshRunner | None = None
+        self._parameter_server = None
+        self.training_histories: list[dict] = []
+
+    # -- properties ----------------------------------------------------
+
+    @property
+    def master_network(self):
+        return self._master_network
+
+    @master_network.setter
+    def master_network(self, network):
+        self._master_network = network
+        self._runner = None
+
+    def get_config(self) -> dict:
+        return {
+            "mode": self.mode,
+            "frequency": self.frequency,
+            "parameter_server_mode": self.parameter_server_mode,
+            "num_workers": self.num_workers,
+            "batch_size": self.batch_size,
+            "port": self.port,
+        }
+
+    # -- parameter server (API parity; see module docstring) -----------
+
+    def start_server(self) -> None:
+        if self.parameter_server_mode is None:
+            return
+        from elephas_tpu.parameter.server import HttpServer, SocketServer
+
+        cls = {"http": HttpServer, "socket": SocketServer}.get(
+            self.parameter_server_mode
+        )
+        if cls is None:
+            raise ValueError(
+                f"parameter_server_mode must be 'http', 'socket' or None, "
+                f"got {self.parameter_server_mode!r}"
+            )
+        self._parameter_server = cls(
+            self._master_network.get_weights(), mode=self.mode, port=self.port
+        )
+        self._parameter_server.start()
+
+    def stop_server(self) -> None:
+        if self._parameter_server is not None:
+            self._parameter_server.stop()
+            self._parameter_server = None
+
+    def _publish_weights(self) -> None:
+        if self._parameter_server is not None:
+            self._parameter_server.set_weights(self._master_network.get_weights())
+
+    # -- training ------------------------------------------------------
+
+    def fit(
+        self,
+        rdd: Rdd,
+        epochs: int = 10,
+        batch_size: int | None = None,
+        verbose: int = 0,
+        validation_split: float = 0.0,
+        **kwargs,
+    ) -> dict:
+        """Train on a simple RDD of ``(x_row, y_row)`` pairs; returns the
+        Keras-style history dict (also appended to ``training_histories``)."""
+        batch_size = batch_size or self.batch_size
+        if rdd.getNumPartitions() != self.num_workers:
+            rdd = rdd.repartition(self.num_workers)
+        partitions = rdd_utils.partition_arrays(rdd)
+        return self._fit_partitions(
+            partitions, epochs, batch_size, verbose, validation_split
+        )
+
+    def _fit_partitions(
+        self, partitions, epochs, batch_size, verbose=0, validation_split=0.0
+    ) -> dict:
+        runner = self._get_runner()
+
+        val_partitions = None
+        if validation_split and validation_split > 0.0:
+            # hold out the global tail fraction (keras semantics), then
+            # re-shard both sets onto the mesh
+            x = np.concatenate([p[0] for p in partitions])
+            y = np.concatenate([p[1] for p in partitions])
+            n_val = min(max(1, int(len(x) * validation_split)), len(x) - 1)
+            partitions = [(x[: len(x) - n_val], y[: len(y) - n_val])]
+            val_partitions = [(x[len(x) - n_val :], y[len(y) - n_val :])]
+        partitions = runner._fit_partitions_to_mesh(partitions)
+
+        self.start_server()
+        try:
+            callbacks = []
+            if self._parameter_server is not None:
+                # keep the external weight store live at epoch boundaries
+                # (run_epochs syncs the master model before each callback)
+                callbacks.append(lambda *_: self._publish_weights())
+            history = runner.run_epochs(
+                partitions, epochs, batch_size, verbose, callbacks=callbacks
+            )
+            if val_partitions is not None:
+                val_results = runner.evaluate(val_partitions, batch_size)
+                for k, v in val_results.items():
+                    history.setdefault(f"val_{k}", []).append(v)
+            self._publish_weights()
+        finally:
+            self.stop_server()
+        self.training_histories.append(history)
+        return history
+
+    # -- inference -----------------------------------------------------
+
+    def predict(self, data, batch_size: int | None = None) -> np.ndarray:
+        """Distributed forward pass. Accepts an Rdd of feature rows or a
+        numpy array; returns stacked predictions in input order."""
+        batch_size = batch_size or self.batch_size
+        runner = self._get_runner()
+        if isinstance(data, Rdd):
+            parts = [
+                np.stack([np.asarray(el) for el in p])
+                for p in data.partitions()
+                if p
+            ]
+        else:
+            arr = np.asarray(data)
+            parts = [a for a in np.array_split(arr, self.num_workers) if len(a)]
+        return runner.predict(parts, batch_size)
+
+    def evaluate(self, x_test, y_test=None, batch_size: int | None = None, **kwargs):
+        """Distributed evaluate. Accepts (x, y) arrays or a simple RDD.
+        Returns ``[loss, *metrics]`` like ``keras.Model.evaluate``."""
+        batch_size = batch_size or self.batch_size
+        runner = self._get_runner()
+        if isinstance(x_test, Rdd):
+            partitions = rdd_utils.partition_arrays(x_test)
+        else:
+            x = np.asarray(x_test)
+            y = np.asarray(y_test)
+            xs = np.array_split(x, self.num_workers)
+            ys = np.array_split(y, self.num_workers)
+            partitions = [(a, b) for a, b in zip(xs, ys) if len(a)]
+        results = runner.evaluate(partitions, batch_size)
+        ordered = [results.pop("loss")] + list(results.values())
+        return ordered if len(ordered) > 1 else ordered[0]
+
+    # -- persistence ---------------------------------------------------
+
+    def save(self, file_name: str) -> None:
+        """Save the trained master network plus elephas config.
+
+        ``.keras``/``.h5`` hold the model; a sidecar ``<file>.elephas.json``
+        carries the distribution config so ``load_spark_model`` restores an
+        equivalent wrapper (reference stores config inside HDF5 attrs;
+        Keras-3's saver owns the archive format here, hence the sidecar).
+        """
+        self._master_network.save(file_name)
+        with open(file_name + ".elephas.json", "w") as f:
+            json.dump(self.get_config(), f)
+
+    def _get_runner(self) -> MeshRunner:
+        if self._runner is None:
+            self._runner = MeshRunner(
+                self._master_network, self.mode, self.frequency, self.mesh
+            )
+        return self._runner
+
+
+class SparkMLlibModel(SparkModel):
+    """SparkModel over MLlib-style ``LabeledPoint`` RDDs
+    (``[U] elephas/spark_model.py::SparkMLlibModel``)."""
+
+    def train(
+        self,
+        labeled_points: Rdd,
+        epochs: int = 10,
+        batch_size: int = 32,
+        categorical: bool = False,
+        nb_classes: int | None = None,
+        **kwargs,
+    ) -> dict:
+        rdd = rdd_utils.lp_to_simple_rdd(labeled_points, categorical, nb_classes)
+        return self.fit(rdd, epochs=epochs, batch_size=batch_size, **kwargs)
+
+    def predict(self, data, batch_size: int | None = None) -> np.ndarray:
+        from elephas_tpu.data.linalg import DenseVector
+
+        if isinstance(data, Rdd):
+            data = data.map(
+                lambda el: el.toArray() if isinstance(el, DenseVector) else el
+            )
+        elif isinstance(data, DenseVector):
+            data = data.toArray()[None]
+        return super().predict(data, batch_size)
+
+
+def load_spark_model(file_name: str) -> SparkModel:
+    """Reload a model saved by :meth:`SparkModel.save`."""
+    import keras
+
+    model = keras.models.load_model(file_name)
+    config = {}
+    sidecar = file_name + ".elephas.json"
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            config = json.load(f)
+    return SparkModel(
+        model,
+        mode=config.get("mode", "synchronous"),
+        frequency=config.get("frequency", "epoch"),
+        parameter_server_mode=config.get("parameter_server_mode"),
+        num_workers=config.get("num_workers"),
+        batch_size=config.get("batch_size", 32),
+        port=config.get("port", 4000),
+    )

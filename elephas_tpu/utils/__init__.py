@@ -1,0 +1,17 @@
+"""Utility layers: weight algebra, serialization, RDD helpers, sockets.
+
+Mirrors ``[U] elephas/utils/`` (see SURVEY.md §2) with pytree-native
+implementations.
+"""
+
+from elephas_tpu.utils.functional_utils import (  # noqa: F401
+    add_params,
+    subtract_params,
+    divide_by,
+    scale_params,
+    get_neutral,
+)
+from elephas_tpu.utils.serialization import (  # noqa: F401
+    model_to_dict,
+    dict_to_model,
+)

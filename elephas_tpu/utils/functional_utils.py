@@ -1,0 +1,51 @@
+"""Weight-list / pytree algebra used by every aggregation path.
+
+Reference surface: ``[U] elephas/utils/functional_utils.py`` —
+``add_params``, ``subtract_params``, ``get_neutral``, ``divide_by``.
+
+The reference operates on Python lists of numpy arrays with explicit
+loops. Here every function is a ``jax.tree.map`` one-liner: it accepts any
+pytree (lists of np/jnp arrays included), runs on-device when given device
+arrays, and is jit-safe so the same algebra can be used *inside* compiled
+training programs (e.g. the local-SGD averaging step).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def add_params(p1, p2):
+    """Elementwise ``p1 + p2`` over two matching pytrees of arrays."""
+    return jax.tree.map(lambda a, b: a + b, p1, p2)
+
+
+def subtract_params(p1, p2):
+    """Elementwise ``p1 - p2`` over two matching pytrees of arrays."""
+    return jax.tree.map(lambda a, b: a - b, p1, p2)
+
+
+def divide_by(params, num_workers):
+    """Divide every leaf by ``num_workers`` (aggregation → average)."""
+    return jax.tree.map(lambda a: a / num_workers, params)
+
+
+def scale_params(params, factor):
+    """Multiply every leaf by ``factor``."""
+    return jax.tree.map(lambda a: a * factor, params)
+
+
+def get_neutral(params):
+    """Zero pytree with the same structure/shapes — the additive identity."""
+    return jax.tree.map(lambda a: a * 0, params)
+
+
+def average_params(param_list):
+    """Average a non-empty sequence of matching pytrees (driver-side sync
+    aggregation, mirroring the reference's collect-and-average)."""
+    if not param_list:
+        raise ValueError("average_params: empty parameter list")
+    total = param_list[0]
+    for p in param_list[1:]:
+        total = add_params(total, p)
+    return divide_by(total, len(param_list))

@@ -1,0 +1,93 @@
+"""RDD construction helpers — the partition→worker mapping layer.
+
+Reference surface: ``[U] elephas/utils/rdd_utils.py`` — ``to_simple_rdd``,
+``to_labeled_point``, ``from_labeled_point``, ``lp_to_simple_rdd``,
+``encode_label``. SURVEY.md §2 flags this as the layer the north star keys
+on: RDD partitions map 1:1 onto TPU mesh workers.
+
+A "simple RDD" is an RDD of ``(features_row, label_row)`` numpy pairs, same
+as the reference. :func:`partition_arrays` is the TPU-side addition: it
+stacks each partition back into contiguous arrays ready for ``device_put``
+with a worker-axis sharding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from elephas_tpu.data.linalg import LabeledPoint
+from elephas_tpu.data.rdd import Rdd
+
+
+def encode_label(label, nb_classes: int) -> np.ndarray:
+    """One-hot encode a scalar label into ``nb_classes`` floats."""
+    encoded = np.zeros(nb_classes, dtype=np.float32)
+    encoded[int(label)] = 1.0
+    return encoded
+
+
+def to_simple_rdd(sc, features, labels, num_partitions: int | None = None) -> Rdd:
+    """Zip feature and label arrays into an RDD of ``(x_row, y_row)`` pairs."""
+    features = np.asarray(features)
+    labels = np.asarray(labels)
+    if len(features) != len(labels):
+        raise ValueError(
+            f"features ({len(features)}) and labels ({len(labels)}) lengths differ"
+        )
+    pairs = list(zip(features, labels))
+    return sc.parallelize(pairs, numSlices=num_partitions)
+
+
+def to_labeled_point(sc, features, labels, categorical: bool = False) -> Rdd:
+    """Build an RDD of :class:`LabeledPoint` from numpy arrays."""
+    features = np.asarray(features)
+    labels = np.asarray(labels)
+    points = []
+    for x, y in zip(features, labels):
+        label = int(np.argmax(y)) if categorical else y
+        points.append(LabeledPoint(label, np.ravel(x)))
+    return sc.parallelize(points)
+
+
+def from_labeled_point(rdd: Rdd, categorical: bool = False, nb_classes: int | None = None):
+    """Convert an RDD of LabeledPoints back into (features, labels) arrays."""
+    points = rdd.collect()
+    features = np.stack([p.features.toArray() for p in points]).astype(np.float32)
+    if categorical:
+        if nb_classes is None:
+            nb_classes = int(max(p.label for p in points)) + 1
+        labels = np.stack([encode_label(p.label, nb_classes) for p in points])
+    else:
+        labels = np.array([p.label for p in points], dtype=np.float32)
+    return features, labels
+
+
+def lp_to_simple_rdd(lp_rdd: Rdd, categorical: bool = False, nb_classes: int | None = None) -> Rdd:
+    """RDD[LabeledPoint] → simple RDD of ``(x_row, y_row)`` pairs."""
+    if categorical and nb_classes is None:
+        nb_classes = int(max(p.label for p in lp_rdd.collect())) + 1
+
+    def convert(p: LabeledPoint):
+        x = p.features.toArray().astype(np.float32)
+        y = encode_label(p.label, nb_classes) if categorical else np.float32(p.label)
+        return (x, y)
+
+    return lp_rdd.map(convert)
+
+
+def partition_arrays(rdd: Rdd) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Stack each partition of a simple RDD into ``(x[P,...], y[P,...])``.
+
+    Empty partitions are dropped: the mesh runner pads worker loads, and a
+    zero-row partition carries no information.
+    """
+    out = []
+    for part in rdd.partitions():
+        if not part:
+            continue
+        xs = np.stack([np.asarray(x) for x, _ in part])
+        ys = np.stack([np.asarray(y) for _, y in part])
+        out.append((xs, ys))
+    if not out:
+        raise ValueError("RDD has no data")
+    return out

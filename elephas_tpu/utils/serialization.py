@@ -1,0 +1,37 @@
+"""Keras model <-> plain-dict serialization.
+
+Reference surface: ``[U] elephas/utils/serialization.py`` —
+``model_to_dict`` / ``dict_to_model`` wrap ``to_json`` + weights so a model
+can ride ordinary pickling between driver and workers.
+
+Here the dict carries the Keras-3 architecture JSON plus host numpy weights.
+Weights are pulled off-device (TPU HBM) into numpy so the dict is cheap to
+pickle/store and never pins device memory.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def model_to_dict(model) -> dict:
+    """Serialize a Keras model to ``{'model': <json str>, 'weights': [np]}``."""
+    return {
+        "model": model.to_json(),
+        "weights": [np.asarray(w) for w in model.get_weights()],
+    }
+
+
+def dict_to_model(dct: dict, custom_objects: dict | None = None):
+    """Rebuild a Keras model from :func:`model_to_dict` output.
+
+    The model comes back *uncompiled* (matching the reference); callers
+    re-compile with their own optimizer/loss/metrics config.
+    """
+    import keras
+
+    model = keras.models.model_from_json(
+        dct["model"], custom_objects=custom_objects
+    )
+    model.set_weights(dct["weights"])
+    return model

@@ -1,0 +1,68 @@
+"""Socket primitives: master discovery + length-prefixed frames.
+
+Reference surface: ``[U] elephas/utils/sockets.py`` — ``determine_master``,
+``send``, ``receive``. Used by the socket parameter server/client
+(:mod:`elephas_tpu.parameter`). The hot training path never touches these;
+they exist for API parity and for low-rate cross-host weight publication
+over DCN.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+
+_LEN = struct.Struct(">Q")
+
+
+def determine_master(port: int = 4000) -> str:
+    """Resolve the coordinator host:port.
+
+    Order mirrors the reference (env override, then hostname lookup) with
+    the JAX-world env names first.
+    """
+    host = (
+        os.environ.get("ELEPHAS_MASTER_IP")
+        or os.environ.get("SPARK_LOCAL_IP")
+        or _local_ip()
+    )
+    return f"{host}:{port}"
+
+
+def _local_ip() -> str:
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def send(sock: socket.socket, obj) -> None:
+    """Send one length-prefixed pickled frame."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def receive(sock: socket.socket):
+    """Receive one length-prefixed pickled frame (None on clean EOF)."""
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        raise ConnectionError("peer closed mid-frame")
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if buf:
+                raise ConnectionError("peer closed mid-frame")
+            return None  # clean EOF at a frame boundary
+        buf += chunk
+    return buf

@@ -1,0 +1,63 @@
+"""Test harness setup.
+
+The reference tests "distribute" via Spark local-mode thread executors in
+one JVM (SURVEY.md §4). The JAX analogue: force an 8-device CPU platform
+so a real ``('workers',)`` mesh exists on one machine, exactly like the
+driver's multi-chip dry-run. This must happen before any test imports
+build JAX state; the axon TPU plugin (registered via sitecustomize) is
+switched out by resetting platforms + clearing backends.
+"""
+
+import os
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+import jax
+
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_platforms", "cpu")
+from jax.extend.backend import clear_backends
+
+clear_backends()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def spark_context():
+    from elephas_tpu.data import SparkContext
+
+    return SparkContext("local[8]")
+
+
+@pytest.fixture(scope="session")
+def blobs():
+    """Separable 3-class gaussian blobs — the MNIST stand-in (no network
+    access for real dataset downloads; end-task-quality assertions follow
+    the reference's loose-threshold style)."""
+    rng = np.random.default_rng(42)
+    n, d, k = 1600, 10, 3
+    centers = rng.normal(size=(k, d)) * 2.0
+    y = rng.integers(0, k, size=n)
+    x = (centers[y] + rng.normal(size=(n, d)) * 0.6).astype(np.float32)
+    return x, y.astype(np.int32), d, k
+
+
+def make_mlp(input_dim: int, num_classes: int, lr: float = 1e-2, seed: int = 7):
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((input_dim,)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(num_classes, activation="softmax"),
+        ]
+    )
+    model.compile(
+        optimizer=keras.optimizers.Adam(lr),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    return model

@@ -1,0 +1,57 @@
+"""SparkContext / Rdd shim behavior (the L0a stand-in, SURVEY.md §1)."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.data import SparkContext
+from elephas_tpu.mllib import from_matrix, from_vector, to_matrix, to_vector
+
+
+def test_parallelize_partition_sizes(spark_context):
+    rdd = spark_context.parallelize(range(10), numSlices=3)
+    sizes = [len(p) for p in rdd.partitions()]
+    assert sizes == [4, 3, 3]
+    assert rdd.collect() == list(range(10))
+
+
+def test_repartition_preserves_elements(spark_context):
+    rdd = spark_context.parallelize(range(17), numSlices=2).repartition(5)
+    assert rdd.getNumPartitions() == 5
+    assert sorted(rdd.collect()) == list(range(17))
+
+
+def test_map_filter_mappartitions(spark_context):
+    rdd = spark_context.parallelize(range(10), numSlices=2)
+    assert rdd.map(lambda v: v * 2).collect() == [v * 2 for v in range(10)]
+    assert rdd.filter(lambda v: v % 2 == 0).count() == 5
+    sums = rdd.mapPartitions(lambda it: [sum(it)]).collect()
+    assert sum(sums) == sum(range(10))
+
+
+def test_actions(spark_context):
+    rdd = spark_context.parallelize([3, 1, 2], numSlices=2)
+    assert rdd.first() == 3
+    assert rdd.take(2) == [3, 1]
+    assert rdd.count() == 3
+    assert rdd.cache() is rdd
+
+
+def test_master_parsing():
+    assert SparkContext("local[4]").defaultParallelism == 4
+    assert SparkContext("local").defaultParallelism == 1
+    with pytest.raises(ValueError):
+        SparkContext("yarn")
+
+
+def test_broadcast(spark_context):
+    b = spark_context.broadcast({"a": 1})
+    assert b.value == {"a": 1}
+
+
+def test_mllib_adapter_roundtrips():
+    m = np.arange(12, dtype=np.float64).reshape(3, 4)
+    np.testing.assert_array_equal(from_matrix(to_matrix(m)), m)
+    v = np.arange(5, dtype=np.float64)
+    np.testing.assert_array_equal(from_vector(to_vector(v)), v)
+    with pytest.raises(ValueError):
+        to_matrix(v)

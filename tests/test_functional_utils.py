@@ -1,0 +1,52 @@
+"""Weight-algebra identities (reference: tests/utils/test_functional_utils.py)."""
+
+import numpy as np
+
+from elephas_tpu.utils.functional_utils import (
+    add_params,
+    average_params,
+    divide_by,
+    get_neutral,
+    scale_params,
+    subtract_params,
+)
+
+
+def _params(seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=(3, 4)).astype(np.float32), rng.normal(size=(4,)).astype(np.float32)]
+
+
+def test_add_subtract_roundtrip():
+    p1, p2 = _params(0), _params(1)
+    out = subtract_params(add_params(p1, p2), p2)
+    for a, b in zip(out, p1):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_neutral_is_additive_identity():
+    p = _params(2)
+    out = add_params(p, get_neutral(p))
+    for a, b in zip(out, p):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_divide_by_and_scale():
+    p = _params(3)
+    out = scale_params(divide_by(p, 4), 4)
+    for a, b in zip(out, p):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_average_params():
+    ps = [_params(i) for i in range(4)]
+    avg = average_params(ps)
+    for leaf_idx in range(len(ps[0])):
+        expected = np.mean([p[leaf_idx] for p in ps], axis=0)
+        np.testing.assert_allclose(avg[leaf_idx], expected, rtol=1e-6)
+
+
+def test_works_on_nested_pytrees():
+    p = {"layer": {"w": np.ones((2, 2)), "b": np.zeros(2)}}
+    out = add_params(p, p)
+    np.testing.assert_array_equal(out["layer"]["w"], 2 * np.ones((2, 2)))

@@ -1,0 +1,75 @@
+"""Parameter server/client round trips (reference: parameter protocol).
+
+Exercises both transports on loopback plus the async-vs-hogwild locking
+semantics (the lock is the only difference between those modes in the
+reference — SURVEY.md §2)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parameter import HttpClient, HttpServer, SocketClient, SocketServer
+
+
+def _weights():
+    return [np.zeros((4, 4), dtype=np.float64), np.zeros(4, dtype=np.float64)]
+
+
+@pytest.mark.parametrize("transport", ["http", "socket"])
+def test_get_update_roundtrip(transport):
+    server_cls, client_cls = {
+        "http": (HttpServer, HttpClient),
+        "socket": (SocketServer, SocketClient),
+    }[transport]
+    server = server_cls(_weights(), mode="asynchronous", port=0)
+    server.start()
+    try:
+        client = client_cls(master=f"127.0.0.1:{server.port}")
+        params = client.get_parameters()
+        assert len(params) == 2
+        delta = [np.ones((4, 4)), np.full(4, 2.0)]
+        client.update_parameters(delta)
+        updated = client.get_parameters()
+        np.testing.assert_array_equal(updated[0], np.ones((4, 4)))
+        np.testing.assert_array_equal(updated[1], np.full(4, 2.0))
+        if transport == "socket":
+            client.close()
+    finally:
+        server.stop()
+
+
+def test_concurrent_async_updates_are_exact():
+    """With the asynchronous-mode lock, N concurrent unit deltas sum to N."""
+    server = HttpServer([np.zeros(8)], mode="asynchronous", port=0)
+    server.start()
+    try:
+        client = HttpClient(master=f"127.0.0.1:{server.port}")
+        n_threads, n_updates = 8, 25
+
+        def worker():
+            c = HttpClient(master=f"127.0.0.1:{server.port}")
+            for _ in range(n_updates):
+                c.update_parameters([np.ones(8)])
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        final = client.get_parameters()[0]
+        np.testing.assert_array_equal(final, np.full(8, n_threads * n_updates))
+    finally:
+        server.stop()
+
+
+def test_set_weights_publishes():
+    server = SocketServer(_weights(), port=0)
+    server.start()
+    try:
+        server.set_weights([np.full((4, 4), 7.0), np.full(4, 7.0)])
+        client = SocketClient(master=f"127.0.0.1:{server.port}")
+        np.testing.assert_array_equal(client.get_parameters()[0], np.full((4, 4), 7.0))
+        client.close()
+    finally:
+        server.stop()

@@ -1,0 +1,56 @@
+"""RDD construction helpers (reference: tests/utils/test_rdd_utils.py)."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.data.linalg import LabeledPoint
+from elephas_tpu.utils import rdd_utils
+
+
+def test_encode_label():
+    enc = rdd_utils.encode_label(2, 5)
+    np.testing.assert_array_equal(enc, [0, 0, 1, 0, 0])
+
+
+def test_to_simple_rdd_shapes(spark_context):
+    x = np.random.rand(100, 8).astype(np.float32)
+    y = np.random.randint(0, 3, 100)
+    rdd = rdd_utils.to_simple_rdd(spark_context, x, y)
+    assert rdd.count() == 100
+    first = rdd.first()
+    assert first[0].shape == (8,)
+
+
+def test_to_simple_rdd_length_mismatch(spark_context):
+    with pytest.raises(ValueError):
+        rdd_utils.to_simple_rdd(spark_context, np.zeros((5, 2)), np.zeros(4))
+
+
+def test_labeled_point_roundtrip(spark_context):
+    x = np.random.rand(40, 6).astype(np.float32)
+    y = np.random.randint(0, 4, 40)
+    onehot = np.eye(4, dtype=np.float32)[y]
+    lp = rdd_utils.to_labeled_point(spark_context, x, onehot, categorical=True)
+    assert isinstance(lp.first(), LabeledPoint)
+    x2, y2 = rdd_utils.from_labeled_point(lp, categorical=True, nb_classes=4)
+    np.testing.assert_allclose(x2, x, rtol=1e-6)
+    np.testing.assert_array_equal(np.argmax(y2, axis=1), y)
+
+
+def test_lp_to_simple_rdd(spark_context):
+    points = [LabeledPoint(i % 3, np.arange(4) + i) for i in range(9)]
+    lp_rdd = spark_context.parallelize(points)
+    simple = rdd_utils.lp_to_simple_rdd(lp_rdd, categorical=True, nb_classes=3)
+    x, y = simple.first()
+    assert x.shape == (4,)
+    assert y.shape == (3,)
+
+
+def test_partition_arrays(spark_context):
+    x = np.random.rand(50, 3).astype(np.float32)
+    y = np.random.randint(0, 2, 50)
+    rdd = rdd_utils.to_simple_rdd(spark_context, x, y, num_partitions=4)
+    parts = rdd_utils.partition_arrays(rdd)
+    assert len(parts) == 4
+    assert sum(len(px) for px, _ in parts) == 50
+    assert parts[0][0].ndim == 2

@@ -1,0 +1,155 @@
+"""SparkModel integration matrix (reference: tests/test_spark_model.py).
+
+Mirrors the reference's strategy: parametrize over mode × frequency, train
+a small classifier, assert end-task accuracy over a loose threshold —
+correctness as task quality, not weight equality (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from elephas_tpu import SparkModel, load_spark_model
+from elephas_tpu.utils.rdd_utils import to_simple_rdd
+from tests.conftest import make_mlp
+
+
+@pytest.mark.parametrize(
+    "mode,frequency",
+    [
+        ("synchronous", "epoch"),
+        ("synchronous", "fit"),  # reference-parity coarse averaging
+        ("asynchronous", "epoch"),
+        ("asynchronous", "batch"),
+        ("hogwild", "epoch"),
+        ("hogwild", "batch"),
+    ],
+)
+def test_training_modes_reach_accuracy(spark_context, blobs, mode, frequency):
+    x, y, d, k = blobs
+    rdd = to_simple_rdd(spark_context, x, y)
+    model = make_mlp(d, k)
+    spark_model = SparkModel(model, mode=mode, frequency=frequency, num_workers=8)
+    history = spark_model.fit(rdd, epochs=5, batch_size=32)
+    assert len(history["loss"]) == 5
+    assert history["loss"][-1] < history["loss"][0]
+    loss, acc = spark_model.evaluate(x, y)
+    assert acc >= 0.80, f"{mode}/{frequency} accuracy {acc}"
+
+
+def test_predict_matches_local_model(spark_context, blobs):
+    x, y, d, k = blobs
+    model = make_mlp(d, k)
+    spark_model = SparkModel(model, num_workers=8)
+    local = np.asarray(model(x[:64]))
+    dist = spark_model.predict(x[:64], batch_size=16)
+    np.testing.assert_allclose(dist, local, rtol=1e-4, atol=1e-5)
+
+
+def test_predict_accepts_rdd(spark_context, blobs):
+    x, y, d, k = blobs
+    model = make_mlp(d, k)
+    spark_model = SparkModel(model, num_workers=8)
+    rdd = spark_context.parallelize([row for row in x[:50]], numSlices=8)
+    preds = spark_model.predict(rdd)
+    assert preds.shape == (50, k)
+
+
+def test_evaluate_matches_keras(spark_context, blobs):
+    """Distributed evaluate must agree with single-process keras evaluate
+    (padding masked exactly) — the parity gate from BASELINE.md."""
+    x, y, d, k = blobs
+    model = make_mlp(d, k)
+    spark_model = SparkModel(model, num_workers=8)
+    dist_loss, dist_acc = spark_model.evaluate(x[:301], y[:301], batch_size=32)
+    ref_loss, ref_acc = model.evaluate(x[:301], y[:301], verbose=0)
+    assert abs(dist_loss - ref_loss) < 1e-3
+    assert abs(dist_acc - ref_acc) < 1e-6
+
+
+def test_validation_split(spark_context, blobs):
+    x, y, d, k = blobs
+    rdd = to_simple_rdd(spark_context, x, y)
+    spark_model = SparkModel(make_mlp(d, k), num_workers=8)
+    history = spark_model.fit(rdd, epochs=2, batch_size=32, validation_split=0.2)
+    assert "val_loss" in history
+
+
+def test_unequal_partitions(spark_context, blobs):
+    """Fewer/ragged partitions than workers must still train (mesh is
+    physical; the runner re-splits)."""
+    x, y, d, k = blobs
+    rdd = to_simple_rdd(spark_context, x[:100], y[:100], num_partitions=3)
+    spark_model = SparkModel(make_mlp(d, k), num_workers=8)
+    history = spark_model.fit(rdd, epochs=1, batch_size=8)
+    assert len(history["loss"]) == 1
+
+
+def test_predict_fewer_rows_than_workers(blobs):
+    """5 inputs on an 8-worker mesh must yield exactly 5 predictions
+    (mesh-filler partitions contribute zero rows)."""
+    x, y, d, k = blobs
+    model = make_mlp(d, k)
+    spark_model = SparkModel(model, num_workers=8)
+    preds = spark_model.predict(x[:5])
+    assert preds.shape == (5, k)
+    np.testing.assert_allclose(preds, np.asarray(model(x[:5])), rtol=1e-4, atol=1e-5)
+
+
+def test_parameter_server_publishes_during_fit(spark_context, blobs):
+    """With parameter_server_mode set, GET /parameters must serve live
+    (trained) weights at epoch boundaries, not the initial ones."""
+    from elephas_tpu.parameter import HttpClient
+
+    x, y, d, k = blobs
+    rdd = to_simple_rdd(spark_context, x, y)
+    model = make_mlp(d, k)
+    initial = [w.copy() for w in model.get_weights()]
+    seen = {}
+
+    spark_model = SparkModel(
+        model, mode="asynchronous", parameter_server_mode="http", num_workers=4, port=0
+    )
+
+    orig_publish = spark_model._publish_weights
+
+    def spy_publish():
+        orig_publish()
+        if spark_model._parameter_server is not None:
+            client = HttpClient(master=f"127.0.0.1:{spark_model._parameter_server.port}")
+            seen.setdefault("weights", []).append(client.get_parameters())
+
+    spark_model._publish_weights = spy_publish
+    spark_model.fit(rdd, epochs=2, batch_size=64)
+    assert seen["weights"], "no epoch-boundary publications observed"
+    first_pub = seen["weights"][0]
+    assert any(
+        not np.array_equal(a, b) for a, b in zip(first_pub, initial)
+    ), "published weights identical to initial — publish-during-fit broken"
+
+
+def test_save_load_roundtrip(tmp_path, spark_context, blobs):
+    x, y, d, k = blobs
+    rdd = to_simple_rdd(spark_context, x, y)
+    spark_model = SparkModel(make_mlp(d, k), mode="asynchronous", num_workers=4)
+    spark_model.fit(rdd, epochs=1, batch_size=32)
+    path = str(tmp_path / "model.keras")
+    spark_model.save(path)
+    restored = load_spark_model(path)
+    assert restored.mode == "asynchronous"
+    np.testing.assert_allclose(
+        restored.predict(x[:16]), spark_model.predict(x[:16]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_rejects_uncompiled_model():
+    import keras
+
+    model = keras.Sequential([keras.layers.Input((4,)), keras.layers.Dense(2)])
+    with pytest.raises(ValueError, match="compiled"):
+        SparkModel(model)
+
+
+def test_rejects_bad_mode(blobs):
+    x, y, d, k = blobs
+    with pytest.raises(ValueError, match="mode"):
+        SparkModel(make_mlp(d, k), mode="nope")
