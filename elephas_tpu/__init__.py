@@ -36,10 +36,22 @@ from elephas_tpu.spark_model import (  # noqa: E402,F401
     SparkMLlibModel,
     load_spark_model,
 )
+from elephas_tpu.ml_model import (  # noqa: E402,F401
+    ElephasEstimator,
+    ElephasTransformer,
+    load_ml_estimator,
+    load_ml_transformer,
+)
+from elephas_tpu.hyperparam import HyperParamModel  # noqa: E402,F401
 
 __all__ = [
     "SparkModel",
     "SparkMLlibModel",
     "load_spark_model",
+    "ElephasEstimator",
+    "ElephasTransformer",
+    "load_ml_estimator",
+    "load_ml_transformer",
+    "HyperParamModel",
     "__version__",
 ]

@@ -1,0 +1,192 @@
+"""DataFrame / Row / SparkSession shims — the pyspark.sql stand-ins.
+
+The reference's ML API (``[U] elephas/ml_model.py``) consumes
+``pyspark.sql.DataFrame``s with a features Vector column and a label
+column (SURVEY.md §3.3). This column-oriented, host-resident stand-in
+carries just the surface those paths use: ``select``, ``withColumn``,
+``columns``, ``collect`` (Rows), ``rdd``, ``count``, ``take``,
+``randomSplit``. Heavy math never happens here — the ML layer converts to
+arrays and hands off to the mesh runner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+
+from elephas_tpu.data.linalg import DenseVector
+from elephas_tpu.data.rdd import Rdd
+
+
+class Row:
+    """Attribute- and key-addressable record."""
+
+    def __init__(self, **fields):
+        self.__dict__["_fields"] = dict(fields)
+
+    def __getattr__(self, name):
+        try:
+            return self._fields[name]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return list(self._fields.values())[key]
+        return self._fields[key]
+
+    def asDict(self) -> dict:
+        return dict(self._fields)
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v!r}" for k, v in self._fields.items())
+        return f"Row({inner})"
+
+    def __eq__(self, other):
+        return isinstance(other, Row) and self._fields == other._fields
+
+    def __hash__(self):
+        return hash(tuple(self._fields.items()))
+
+
+class DataFrame:
+    """Column-store of equal-length Python lists."""
+
+    def __init__(self, data: dict[str, list[Any]]):
+        lengths = {len(v) for v in data.values()}
+        if len(lengths) > 1:
+            raise ValueError(f"ragged columns: { {k: len(v) for k, v in data.items()} }")
+        self._data = {k: list(v) for k, v in data.items()}
+
+    # -- schema --------------------------------------------------------
+
+    @property
+    def columns(self) -> list[str]:
+        return list(self._data)
+
+    def count(self) -> int:
+        return len(next(iter(self._data.values()), []))
+
+    # -- transformations ----------------------------------------------
+
+    def select(self, *cols: str) -> "DataFrame":
+        if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
+            cols = tuple(cols[0])
+        missing = [c for c in cols if c not in self._data]
+        if missing:
+            raise KeyError(f"no such column(s): {missing}; have {self.columns}")
+        return DataFrame({c: self._data[c] for c in cols})
+
+    def withColumn(self, name: str, values: Iterable[Any]) -> "DataFrame":
+        values = list(values)
+        if self._data and len(values) != self.count():
+            raise ValueError(
+                f"withColumn {name!r}: {len(values)} values for {self.count()} rows"
+            )
+        out = dict(self._data)
+        out[name] = values
+        return DataFrame(out)
+
+    def drop(self, *cols: str) -> "DataFrame":
+        return DataFrame({k: v for k, v in self._data.items() if k not in cols})
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        return DataFrame({(new if k == old else k): v for k, v in self._data.items()})
+
+    def randomSplit(self, weights: list[float], seed: int = 0) -> list["DataFrame"]:
+        n = self.count()
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(n)
+        total = sum(weights)
+        bounds = np.cumsum([int(round(w / total * n)) for w in weights])[:-1]
+        chunks = np.split(perm, bounds)
+        return [
+            DataFrame({k: [v[i] for i in idx] for k, v in self._data.items()})
+            for idx in chunks
+        ]
+
+    # -- actions -------------------------------------------------------
+
+    def collect(self) -> list[Row]:
+        cols = self.columns
+        return [
+            Row(**{c: self._data[c][i] for c in cols}) for i in range(self.count())
+        ]
+
+    def take(self, n: int) -> list[Row]:
+        cols = self.columns
+        return [
+            Row(**{c: self._data[c][i] for c in cols})
+            for i in range(min(n, self.count()))
+        ]
+
+    def first(self) -> Row:
+        rows = self.take(1)
+        if not rows:
+            raise ValueError("first() on empty DataFrame")
+        return rows[0]
+
+    @property
+    def rdd(self) -> Rdd:
+        return Rdd([self.collect()])
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    # -- column access -------------------------------------------------
+
+    def column_values(self, name: str) -> list[Any]:
+        return self._data[name]
+
+
+class SparkSession:
+    """Minimal ``SparkSession``: builds DataFrames from rows or columns."""
+
+    def __init__(self, spark_context=None):
+        from elephas_tpu.data.context import SparkContext
+
+        self.sparkContext = spark_context or SparkContext()
+
+    class _Builder:
+        def getOrCreate(self) -> "SparkSession":
+            return SparkSession()
+
+        def appName(self, _name: str) -> "SparkSession._Builder":
+            return self
+
+        def master(self, _master: str) -> "SparkSession._Builder":
+            return self
+
+    builder = _Builder()
+
+    def createDataFrame(self, data, schema: list[str] | None = None) -> DataFrame:
+        """Accepts an Rdd/list of tuples + column names, a list of Rows, or
+        a dict of columns."""
+        if isinstance(data, dict):
+            return DataFrame(data)
+        if isinstance(data, Rdd):
+            data = data.collect()
+        data = list(data)
+        if not data:
+            raise ValueError("cannot create DataFrame from empty data")
+        if isinstance(data[0], Row):
+            cols = data[0].asDict().keys()
+            return DataFrame({c: [r[c] for r in data] for c in cols})
+        if schema is None:
+            raise ValueError("schema (column names) required for tuple rows")
+        return DataFrame(
+            {name: [row[i] for row in data] for i, name in enumerate(schema)}
+        )
+
+
+def vectorize_column(values: list[Any]) -> np.ndarray:
+    """Features column (DenseVectors / arrays / scalars) → 2-D float array."""
+    rows = []
+    for v in values:
+        if isinstance(v, DenseVector):
+            rows.append(v.toArray())
+        else:
+            rows.append(np.ravel(np.asarray(v, dtype=np.float32)))
+    return np.stack(rows).astype(np.float32)

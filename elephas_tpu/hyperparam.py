@@ -1,0 +1,196 @@
+"""HyperParamModel — distributed hyperparameter search.
+
+Reference surface: ``[U] elephas/hyperparam.py`` — ``HyperParamModel(sc,
+num_workers).minimize(model, data, max_evals)`` parallelizes
+hyperas/hyperopt ``fmin`` trials across Spark workers and collects the
+per-worker bests (SURVEY.md §3.5).
+
+hyperas/hyperopt are not dependencies of this rebuild (and hyperas's
+notebook-templating model is CPython-source rewriting — not something a
+TPU framework should carry). The same capability is provided natively:
+
+- a small search-space DSL (:func:`choice`, :func:`uniform`,
+  :func:`loguniform`, :func:`quniform`) with random sampling (the
+  default hyperopt ``rand.suggest`` behavior);
+- ``minimize(model, data, max_evals, search_space)`` where ``model`` is a
+  builder ``params -> compiled keras.Model`` and ``data`` is either a
+  tuple ``(x_train, y_train, x_val, y_val)`` or a zero-arg callable
+  returning one;
+- trials are placed round-robin on the mesh devices (each trial trains
+  single-device via a 1-device mesh runner — architectures differ across
+  trials, so they cannot share one SPMD program the way one model's data
+  parallelism can).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+# -- search space DSL --------------------------------------------------
+
+
+@dataclass
+class _Dist:
+    kind: str
+    args: tuple = ()
+
+    def sample(self, rng: np.random.Generator):
+        if self.kind == "choice":
+            options = self.args[0]
+            return options[int(rng.integers(len(options)))]
+        if self.kind == "uniform":
+            lo, hi = self.args
+            return float(rng.uniform(lo, hi))
+        if self.kind == "loguniform":
+            lo, hi = self.args
+            return float(np.exp(rng.uniform(np.log(lo), np.log(hi))))
+        if self.kind == "quniform":
+            lo, hi, q = self.args
+            value = round(rng.uniform(lo, hi) / q) * q
+            # integral q keeps hyperopt's int-like behavior; fractional q
+            # must stay float (int() would truncate 0.5 -> 0)
+            return int(value) if float(q).is_integer() else float(value)
+        raise ValueError(f"unknown distribution {self.kind}")
+
+
+def choice(options) -> _Dist:
+    return _Dist("choice", (list(options),))
+
+
+def uniform(low: float, high: float) -> _Dist:
+    return _Dist("uniform", (low, high))
+
+
+def loguniform(low: float, high: float) -> _Dist:
+    return _Dist("loguniform", (low, high))
+
+
+def quniform(low: float, high: float, q: float = 1) -> _Dist:
+    return _Dist("quniform", (low, high, q))
+
+
+def sample_space(space: dict, rng: np.random.Generator) -> dict:
+    return {
+        k: (v.sample(rng) if isinstance(v, _Dist) else v) for k, v in space.items()
+    }
+
+
+# -- trials ------------------------------------------------------------
+
+
+@dataclass
+class Trial:
+    params: dict
+    loss: float
+    metrics: dict = field(default_factory=dict)
+    weights: list | None = None
+    model_json: str | None = None
+
+
+class HyperParamModel:
+    """Distributed random search over Keras model builders."""
+
+    def __init__(self, sc=None, num_workers: int | None = None, seed: int | None = None):
+        import jax
+
+        self.sc = sc  # accepted for API parity; search needs no RDDs
+        devices = jax.devices()
+        self.num_workers = min(num_workers or len(devices), len(devices))
+        self.devices = devices
+        self.seed = seed
+        self.trials: list[Trial] = []
+        self.best_models: list = []
+
+    def minimize(
+        self,
+        model: Callable[[dict], Any],
+        data,
+        max_evals: int = 16,
+        search_space: dict | None = None,
+        epochs: int = 5,
+        batch_size: int = 32,
+        verbose: int = 0,
+    ):
+        """Run ``max_evals`` sampled trials; returns the best trained model.
+
+        ``model(params)`` must return a *compiled* keras model;
+        ``data`` is ``(x_train, y_train, x_val, y_val)`` or a callable
+        producing it. Per-trial validation loss decides the winner.
+        """
+        from jax.sharding import Mesh
+
+        from elephas_tpu.worker import MeshRunner
+
+        if callable(data):
+            data = data()
+        x_train, y_train, x_val, y_val = data
+        search_space = search_space or {}
+        rng = np.random.default_rng(self.seed)
+
+        # Models are built sequentially (Keras layer-naming state is
+        # global), then trials train/evaluate concurrently — one thread per
+        # mesh device, each on its own 1-device mesh, so an 8-device mesh
+        # runs 8 trials at a time instead of leaving 7 devices idle.
+        builds = []
+        for i in range(max_evals):
+            params = sample_space(search_space, rng)
+            trial_model = model(params)
+            if getattr(trial_model, "optimizer", None) is None:
+                raise ValueError(
+                    "model builder must return a compiled keras model"
+                )
+            builds.append((params, trial_model))
+
+        def run_trial(i: int) -> Trial:
+            params, trial_model = builds[i]
+            device = self.devices[i % self.num_workers]
+            mesh = Mesh(np.array([device]), ("workers",))
+            runner = MeshRunner(trial_model, "synchronous", "epoch", mesh)
+            runner.run_epochs(
+                [(x_train, y_train)], epochs=epochs, batch_size=batch_size
+            )
+            results = runner.evaluate([(x_val, y_val)], batch_size=batch_size)
+            trial = Trial(
+                params=params,
+                loss=results["loss"],
+                metrics=results,
+                weights=trial_model.get_weights(),
+                model_json=trial_model.to_json(),
+            )
+            if verbose:
+                logger.info(
+                    "trial %d/%d: params=%s val_loss=%.4f",
+                    i + 1,
+                    max_evals,
+                    params,
+                    trial.loss,
+                )
+            return trial
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            self.trials = list(pool.map(run_trial, range(max_evals)))
+
+        best = self.best_trial()
+        import keras
+
+        best_model = keras.models.model_from_json(best.model_json)
+        best_model.set_weights(best.weights)
+        self.best_models = [best_model]
+        return best_model
+
+    def best_trial(self) -> Trial:
+        if not self.trials:
+            raise ValueError("no trials run yet")
+        return min(self.trials, key=lambda t: t.loss)
+
+    def best_model_params(self) -> dict:
+        return self.best_trial().params

@@ -1,0 +1,8 @@
+"""Spark-ML-style pipeline layer (``[U] elephas/ml/``)."""
+
+from elephas_tpu.ml.adapter import (  # noqa: F401
+    df_to_simple_rdd,
+    from_data_frame,
+    to_data_frame,
+)
+from elephas_tpu.ml.pipeline import Pipeline, PipelineModel  # noqa: F401

@@ -1,0 +1,69 @@
+"""DataFrame <-> simple-RDD conversion.
+
+Reference surface: ``[U] elephas/ml/adapter.py`` — ``df_to_simple_rdd``
+(features Vector column + label column → RDD of (x, y) numpy pairs, with
+optional one-hot), ``to_data_frame``, ``from_data_frame``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from elephas_tpu.data.dataframe import DataFrame, vectorize_column
+from elephas_tpu.data.linalg import DenseVector
+from elephas_tpu.data.rdd import Rdd
+from elephas_tpu.utils.rdd_utils import encode_label, to_simple_rdd
+
+
+def df_to_simple_rdd(
+    df: DataFrame,
+    categorical: bool = False,
+    nb_classes: int | None = None,
+    features_col: str = "features",
+    label_col: str = "label",
+    num_partitions: int | None = None,
+) -> Rdd:
+    """DataFrame → simple RDD of ``(features_row, label_row)`` pairs."""
+    from elephas_tpu.data.context import SparkContext
+
+    features, labels = from_data_frame(
+        df, categorical, nb_classes, features_col, label_col
+    )
+    return to_simple_rdd(
+        SparkContext(), features, labels, num_partitions=num_partitions
+    )
+
+
+def to_data_frame(sc, features, labels, categorical: bool = False) -> DataFrame:
+    """numpy arrays → DataFrame(features: DenseVector, label: float)."""
+    features = np.asarray(features)
+    labels = np.asarray(labels)
+    label_values = [
+        float(np.argmax(y)) if categorical else float(np.ravel(y)[0] if np.ndim(y) else y)
+        for y in labels
+    ]
+    return DataFrame(
+        {
+            "features": [DenseVector(np.ravel(x)) for x in features],
+            "label": label_values,
+        }
+    )
+
+
+def from_data_frame(
+    df: DataFrame,
+    categorical: bool = False,
+    nb_classes: int | None = None,
+    features_col: str = "features",
+    label_col: str = "label",
+):
+    """DataFrame → (features, labels) numpy arrays."""
+    features = vectorize_column(df.column_values(features_col))
+    raw = df.column_values(label_col)
+    if categorical:
+        if nb_classes is None:
+            nb_classes = int(max(raw)) + 1
+        labels = np.stack([encode_label(l, nb_classes) for l in raw])
+    else:
+        labels = np.asarray(raw, dtype=np.float32)
+    return features, labels

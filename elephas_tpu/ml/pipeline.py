@@ -1,0 +1,44 @@
+"""Minimal ML Pipeline — stage chaining for Estimators/Transformers.
+
+The reference plugs ``ElephasEstimator`` into ``pyspark.ml.Pipeline``
+(SURVEY.md §3.3). pyspark is not a dependency here, so this module
+supplies the two-class Pipeline contract those flows use: an Estimator
+stage exposes ``fit(df) -> Transformer``; a Transformer stage exposes
+``transform(df) -> df``; ``Pipeline.fit`` folds a DataFrame through the
+stages and returns a ``PipelineModel`` of fitted transformers.
+"""
+
+from __future__ import annotations
+
+
+class Pipeline:
+    def __init__(self, stages: list):
+        self.stages = list(stages)
+
+    def fit(self, df):
+        fitted = []
+        current = df
+        for i, stage in enumerate(self.stages):
+            is_last = i == len(self.stages) - 1
+            if hasattr(stage, "fit"):
+                model = stage.fit(current)
+                fitted.append(model)
+                if not is_last:  # the last stage's output is never consumed
+                    current = model.transform(current)
+            elif hasattr(stage, "transform"):
+                fitted.append(stage)
+                if not is_last:
+                    current = stage.transform(current)
+            else:
+                raise TypeError(f"stage {stage!r} has neither fit nor transform")
+        return PipelineModel(fitted)
+
+
+class PipelineModel:
+    def __init__(self, stages: list):
+        self.stages = list(stages)
+
+    def transform(self, df):
+        for stage in self.stages:
+            df = stage.transform(df)
+        return df

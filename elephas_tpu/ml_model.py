@@ -1,0 +1,254 @@
+"""ElephasEstimator / ElephasTransformer — the ML-pipeline API.
+
+Reference surface: ``[U] elephas/ml_model.py`` (SURVEY.md §2, §3.3):
+
+- ``ElephasEstimator`` (an Estimator mixing in the ``Has*`` params):
+  ``fit(df)`` converts the DataFrame to a simple RDD, deserializes the
+  Keras model from the ``keras_model_config`` JSON param, trains a
+  ``SparkModel`` with the configured mode/frequency/workers, and returns a
+  fitted ``ElephasTransformer`` carrying the trained weights.
+- ``ElephasTransformer`` (a Model/Transformer): ``transform(df)`` runs the
+  distributed forward pass over the features column and joins predictions
+  back as the output column, preserving existing columns.
+- ``load_ml_estimator`` / ``load_ml_transformer`` reload saved stages.
+
+The keras model and optimizer ride as JSON config strings — the same
+string-keyed contract the reference uses so configs survive
+serialization.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from elephas_tpu.data.dataframe import DataFrame, vectorize_column
+from elephas_tpu.ml.adapter import df_to_simple_rdd
+from elephas_tpu.ml.params import (
+    HasBatchSize,
+    HasCategoricalLabels,
+    HasCustomObjects,
+    HasEpochs,
+    HasFeaturesCol,
+    HasFrequency,
+    HasKerasModelConfig,
+    HasLabelCol,
+    HasLoss,
+    HasMetrics,
+    HasMode,
+    HasNumberOfClasses,
+    HasNumberOfWorkers,
+    HasOptimizerConfig,
+    HasOutputCol,
+    HasParameterServerMode,
+    HasPredictClasses,
+    HasValidationSplit,
+    HasVerbosity,
+)
+
+
+class _ElephasParams(
+    HasKerasModelConfig,
+    HasOptimizerConfig,
+    HasMode,
+    HasFrequency,
+    HasNumberOfWorkers,
+    HasEpochs,
+    HasBatchSize,
+    HasVerbosity,
+    HasValidationSplit,
+    HasLoss,
+    HasMetrics,
+    HasNumberOfClasses,
+    HasCategoricalLabels,
+    HasFeaturesCol,
+    HasLabelCol,
+    HasOutputCol,
+    HasCustomObjects,
+    HasParameterServerMode,
+    HasPredictClasses,
+):
+    pass
+
+
+def _build_model(config: dict):
+    """keras_model_config + optimizer/loss/metrics params → compiled model."""
+    import keras
+
+    model_json = config.get("keras_model_config")
+    if not model_json:
+        raise ValueError("keras_model_config param is required")
+    model = keras.models.model_from_json(
+        model_json, custom_objects=config.get("custom_objects")
+    )
+    opt_config = config.get("optimizer_config")
+    if isinstance(opt_config, str):
+        opt_config = json.loads(opt_config)
+    optimizer = (
+        keras.optimizers.deserialize(opt_config) if opt_config else "rmsprop"
+    )
+    loss = config.get("loss")
+    if not loss:
+        raise ValueError("loss param is required")
+    model.compile(
+        optimizer=optimizer, loss=loss, metrics=config.get("metrics") or None
+    )
+    return model
+
+
+class ElephasEstimator(_ElephasParams):
+    """Trains a distributed Keras model from DataFrame input."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self.setParams(**kwargs)
+
+    def fit(self, df: DataFrame) -> "ElephasTransformer":
+        return self._fit(df)
+
+    def _fit(self, df: DataFrame) -> "ElephasTransformer":
+        from elephas_tpu.spark_model import SparkModel
+
+        config = self.get_config()
+        model = _build_model(config)
+        rdd = df_to_simple_rdd(
+            df,
+            categorical=config["categorical_labels"],
+            nb_classes=config["nb_classes"],
+            features_col=config["features_col"],
+            label_col=config["label_col"],
+        )
+        spark_model = SparkModel(
+            model,
+            mode=config["mode"],
+            frequency=config["frequency"],
+            parameter_server_mode=config["parameter_server_mode"],
+            num_workers=config["num_workers"],
+            custom_objects=config["custom_objects"],
+            batch_size=config["batch_size"],
+        )
+        spark_model.fit(
+            rdd,
+            epochs=config["epochs"],
+            batch_size=config["batch_size"],
+            verbose=config["verbose"],
+            validation_split=config["validation_split"],
+        )
+        weights = spark_model.master_network.get_weights()
+        transformer = ElephasTransformer(
+            weights=weights,
+            keras_model_config=config["keras_model_config"],
+            custom_objects=config["custom_objects"],
+        )
+        transformer.set_config(
+            {
+                k: config[k]
+                for k in (
+                    "features_col",
+                    "label_col",
+                    "output_col",
+                    "batch_size",
+                    "num_workers",
+                    "predict_classes",
+                    "categorical_labels",
+                    "nb_classes",
+                )
+            }
+        )
+        return transformer
+
+    def save(self, file_name: str) -> None:
+        """Persist the string-keyed config. ``custom_objects`` hold live
+        classes/functions and cannot ride JSON — they are dropped here and
+        must be re-supplied to :func:`load_ml_estimator` (same contract as
+        Keras's own custom-object handling)."""
+        config = self.get_config()
+        config.pop("custom_objects", None)
+        with open(file_name, "w") as f:
+            json.dump({"estimator_config": config}, f)
+
+    def get_model(self):
+        return _build_model(self.get_config())
+
+
+class ElephasTransformer(_ElephasParams):
+    """Applies a trained Keras model to a DataFrame."""
+
+    def __init__(self, weights=None, **kwargs):
+        super().__init__()
+        self.setParams(**kwargs)
+        self.weights = [np.asarray(w) for w in weights] if weights is not None else None
+
+    def get_model(self):
+        import keras
+
+        model = keras.models.model_from_json(
+            self.getOrDefault("keras_model_config"),
+            custom_objects=self.getOrDefault("custom_objects"),
+        )
+        if self.weights is not None:
+            model.set_weights(self.weights)
+        return model
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return self._transform(df)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        from elephas_tpu.spark_model import SparkModel
+
+        model = self.get_model()
+        # predict-only path still rides SparkModel (one partitioning/mesh
+        # implementation); the compile config is irrelevant to forward
+        if getattr(model, "optimizer", None) is None:
+            model.compile(optimizer="sgd", loss="mean_squared_error")
+        spark_model = SparkModel(
+            model,
+            num_workers=self.getOrDefault("num_workers"),
+            batch_size=self.getBatchSize(),
+        )
+        features = vectorize_column(df.column_values(self.getFeaturesCol()))
+        preds = spark_model.predict(features, self.getBatchSize())
+        if self.getPredictClasses():
+            values = [int(np.argmax(p)) for p in preds]
+        else:
+            values = [np.asarray(p) for p in preds]
+        return df.withColumn(self.getOutputCol(), values)
+
+    def save(self, file_name: str) -> None:
+        """Persist config + weights as JSON. ``custom_objects`` are live
+        objects and are dropped — re-supply them to
+        :func:`load_ml_transformer`."""
+        config = self.get_config()
+        config.pop("custom_objects", None)
+        payload = {
+            "transformer_config": config,
+            "weights": [w.tolist() for w in (self.weights or [])],
+            "weight_dtypes": [str(w.dtype) for w in (self.weights or [])],
+        }
+        with open(file_name, "w") as f:
+            json.dump(payload, f)
+
+
+def load_ml_estimator(file_name: str, custom_objects: dict | None = None) -> ElephasEstimator:
+    with open(file_name) as f:
+        payload = json.load(f)
+    est = ElephasEstimator()
+    est.set_config(payload["estimator_config"])
+    if custom_objects is not None:
+        est.setCustomObjects(custom_objects)
+    return est
+
+
+def load_ml_transformer(file_name: str, custom_objects: dict | None = None) -> ElephasTransformer:
+    with open(file_name) as f:
+        payload = json.load(f)
+    weights = [
+        np.asarray(w, dtype=d)
+        for w, d in zip(payload["weights"], payload["weight_dtypes"])
+    ]
+    t = ElephasTransformer(weights=weights)
+    t.set_config(payload["transformer_config"])
+    if custom_objects is not None:
+        t.setCustomObjects(custom_objects)
+    return t

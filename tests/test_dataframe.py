@@ -1,0 +1,63 @@
+"""DataFrame/Row/SparkSession shim behavior."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.data.dataframe import DataFrame, Row, SparkSession, vectorize_column
+from elephas_tpu.data.linalg import DenseVector
+
+
+def test_create_from_tuples():
+    session = SparkSession.builder.getOrCreate()
+    df = session.createDataFrame([(1, "a"), (2, "b")], schema=["id", "name"])
+    assert df.columns == ["id", "name"]
+    assert df.count() == 2
+    assert df.collect()[1].name == "b"
+
+
+def test_create_from_rows():
+    session = SparkSession()
+    df = session.createDataFrame([Row(id=1, v=2.0), Row(id=2, v=3.0)])
+    assert df.column_values("v") == [2.0, 3.0]
+
+
+def test_select_withcolumn_drop():
+    df = DataFrame({"a": [1, 2], "b": [3, 4]})
+    assert df.select("a").columns == ["a"]
+    with pytest.raises(KeyError):
+        df.select("nope")
+    df2 = df.withColumn("c", [5, 6])
+    assert df2.column_values("c") == [5, 6]
+    assert df2.drop("a").columns == ["b", "c"]
+    with pytest.raises(ValueError):
+        df.withColumn("bad", [1])
+
+
+def test_ragged_columns_rejected():
+    with pytest.raises(ValueError):
+        DataFrame({"a": [1], "b": [1, 2]})
+
+
+def test_random_split():
+    df = DataFrame({"a": list(range(100))})
+    train, test = df.randomSplit([0.8, 0.2], seed=1)
+    assert train.count() + test.count() == 100
+    assert abs(train.count() - 80) <= 2
+    assert sorted(train.column_values("a") + test.column_values("a")) == list(range(100))
+
+
+def test_row_access():
+    r = Row(x=1, y="z")
+    assert r.x == 1
+    assert r["y"] == "z"
+    assert r[0] == 1
+    assert r.asDict() == {"x": 1, "y": "z"}
+    with pytest.raises(AttributeError):
+        r.missing
+
+
+def test_vectorize_column():
+    col = [DenseVector([1, 2]), np.array([3, 4]), [5, 6]]
+    arr = vectorize_column(col)
+    assert arr.shape == (3, 2)
+    assert arr.dtype == np.float32

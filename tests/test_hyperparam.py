@@ -1,0 +1,91 @@
+"""Hyperparameter search (reference: tests/test_hyperparam.py shape —
+a tiny max_evals search completes and returns a usable best model)."""
+
+import numpy as np
+import pytest
+
+import keras
+
+from elephas_tpu.hyperparam import (
+    HyperParamModel,
+    choice,
+    loguniform,
+    quniform,
+    sample_space,
+    uniform,
+)
+
+
+def test_search_space_sampling():
+    rng = np.random.default_rng(0)
+    space = {
+        "units": choice([8, 16, 32]),
+        "lr": loguniform(1e-4, 1e-1),
+        "dropout": uniform(0.0, 0.5),
+        "layers": quniform(1, 3),
+        "fixed": "adam",
+    }
+    for _ in range(20):
+        s = sample_space(space, rng)
+        assert s["units"] in (8, 16, 32)
+        assert 1e-4 <= s["lr"] <= 1e-1
+        assert 0.0 <= s["dropout"] <= 0.5
+        assert s["layers"] in (1, 2, 3)
+        assert s["fixed"] == "adam"
+
+
+def test_quniform_fractional_q():
+    rng = np.random.default_rng(1)
+    dist = quniform(0.1, 0.9, q=0.1)
+    samples = {round(dist.sample(rng), 10) for _ in range(50)}
+    assert all(0.1 <= s <= 0.9 for s in samples)
+    assert len(samples) > 1, "fractional quniform collapsed to a single value"
+
+
+def test_minimize_returns_trained_best(blobs):
+    x, y, d, k = blobs
+    split = int(len(x) * 0.8)
+    data = (x[:split], y[:split], x[split:], y[split:])
+
+    def build(params):
+        model = keras.Sequential(
+            [
+                keras.layers.Input((d,)),
+                keras.layers.Dense(params["units"], activation="relu"),
+                keras.layers.Dense(k, activation="softmax"),
+            ]
+        )
+        model.compile(
+            optimizer=keras.optimizers.Adam(params["lr"]),
+            loss="sparse_categorical_crossentropy",
+            metrics=["accuracy"],
+        )
+        return model
+
+    hp = HyperParamModel(num_workers=4, seed=3)
+    best = hp.minimize(
+        build,
+        data,
+        max_evals=3,
+        search_space={"units": choice([16, 32]), "lr": loguniform(1e-3, 1e-2)},
+        epochs=3,
+        batch_size=64,
+    )
+    assert len(hp.trials) == 3
+    trial = hp.best_trial()
+    assert trial.loss == min(t.loss for t in hp.trials)
+    assert trial.metrics.get("accuracy", 0) >= 0.8
+    preds = np.asarray(best(x[:4]))
+    assert preds.shape == (4, k)
+    assert hp.best_model_params()["units"] in (16, 32)
+
+
+def test_uncompiled_builder_rejected(blobs):
+    x, y, d, k = blobs
+
+    def build(params):
+        return keras.Sequential([keras.layers.Input((d,)), keras.layers.Dense(k)])
+
+    hp = HyperParamModel(num_workers=2)
+    with pytest.raises(ValueError, match="compiled"):
+        hp.minimize(build, (x, y, x, y), max_evals=1)

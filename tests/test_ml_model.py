@@ -1,0 +1,143 @@
+"""ML pipeline integration (reference: tests/test_ml_model.py).
+
+Builds a real Pipeline over a DataFrame, fits, transforms, checks the
+prediction column and save/load round trips — mirroring the reference's
+test shape (SURVEY.md §4)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import keras
+
+from elephas_tpu.data.dataframe import SparkSession
+from elephas_tpu.ml import Pipeline, df_to_simple_rdd, from_data_frame, to_data_frame
+from elephas_tpu.ml_model import (
+    ElephasEstimator,
+    ElephasTransformer,
+    load_ml_estimator,
+    load_ml_transformer,
+)
+
+
+@pytest.fixture(scope="module")
+def df(blobs):
+    x, y, d, k = blobs
+    session = SparkSession()
+    return session.createDataFrame(
+        [(row, float(label)) for row, label in zip(x, y)],
+        schema=["features", "label"],
+    )
+
+
+def _estimator(d, k, **overrides):
+    model = keras.Sequential(
+        [
+            keras.layers.Input((d,)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(k, activation="softmax"),
+        ]
+    )
+    params = dict(
+        keras_model_config=model.to_json(),
+        optimizer_config=keras.optimizers.serialize(keras.optimizers.Adam(1e-2)),
+        loss="categorical_crossentropy",
+        metrics=["accuracy"],
+        categorical_labels=True,
+        nb_classes=k,
+        epochs=4,
+        batch_size=32,
+        num_workers=8,
+        mode="synchronous",
+        predict_classes=True,
+    )
+    params.update(overrides)
+    return ElephasEstimator(**params)
+
+
+def test_estimator_fit_transform_accuracy(df, blobs):
+    x, y, d, k = blobs
+    est = _estimator(d, k)
+    transformer = est.fit(df)
+    assert isinstance(transformer, ElephasTransformer)
+    out = transformer.transform(df)
+    assert "prediction" in out.columns
+    preds = np.array(out.column_values("prediction"))
+    labels = np.array(out.column_values("label"))
+    acc = (preds == labels).mean()
+    assert acc >= 0.80, f"pipeline accuracy {acc}"
+
+
+def test_pipeline_chaining(df, blobs):
+    x, y, d, k = blobs
+    pipeline = Pipeline(stages=[_estimator(d, k, epochs=2)])
+    fitted = pipeline.fit(df)
+    out = fitted.transform(df)
+    assert "prediction" in out.columns
+    assert len(out.column_values("prediction")) == df.count()
+
+
+def test_raw_probability_output(df, blobs):
+    x, y, d, k = blobs
+    est = _estimator(d, k, epochs=1, predict_classes=False)
+    out = est.fit(df).transform(df)
+    first = out.column_values("prediction")[0]
+    assert np.asarray(first).shape == (k,)
+
+
+def test_estimator_save_load(tmp_path, df, blobs):
+    x, y, d, k = blobs
+    est = _estimator(d, k, epochs=1)
+    path = str(tmp_path / "estimator.json")
+    est.save(path)
+    loaded = load_ml_estimator(path)
+    assert loaded.getOrDefault("keras_model_config") == est.getOrDefault(
+        "keras_model_config"
+    )
+    assert loaded.getOrDefault("nb_classes") == k
+    # loaded estimator must be trainable
+    transformer = loaded.fit(df)
+    assert transformer.weights
+
+
+def test_transformer_save_load(tmp_path, df, blobs):
+    x, y, d, k = blobs
+    transformer = _estimator(d, k, epochs=1).fit(df)
+    path = str(tmp_path / "transformer.json")
+    transformer.save(path)
+    loaded = load_ml_transformer(path)
+    out1 = transformer.transform(df).column_values("prediction")
+    out2 = loaded.transform(df).column_values("prediction")
+    assert out1 == out2
+
+
+def test_estimator_requires_loss(df):
+    est = ElephasEstimator(keras_model_config="{}")
+    with pytest.raises(ValueError, match="loss"):
+        est.fit(df)
+
+
+def test_adapter_roundtrips(spark_context, blobs):
+    x, y, d, k = blobs
+    df = to_data_frame(spark_context, x[:40], y[:40], categorical=False)
+    x2, y2 = from_data_frame(df)
+    np.testing.assert_allclose(x2, x[:40], rtol=1e-6)
+    np.testing.assert_array_equal(y2, y[:40].astype(np.float32))
+
+    rdd = df_to_simple_rdd(df, categorical=True, nb_classes=k)
+    xr, yr = rdd.first()
+    assert xr.shape == (d,)
+    assert yr.shape == (k,)
+
+
+def test_param_surface():
+    est = ElephasEstimator()
+    est.setEpochs(7).setBatchSize(16).setMode("hogwild").setFrequency("batch")
+    assert est.getEpochs() == 7
+    assert est.getBatchSize() == 16
+    cfg = est.get_config()
+    assert cfg["mode"] == "hogwild"
+    est2 = ElephasEstimator()
+    est2.set_config(cfg)
+    assert est2.getFrequency() == "batch"
