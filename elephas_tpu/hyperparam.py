@@ -90,8 +90,6 @@ class Trial:
     params: dict
     loss: float
     metrics: dict = field(default_factory=dict)
-    weights: list | None = None
-    model_json: str | None = None
 
 
 class HyperParamModel:
@@ -134,36 +132,56 @@ class HyperParamModel:
         search_space = search_space or {}
         rng = np.random.default_rng(self.seed)
 
-        # Models are built sequentially (Keras layer-naming state is
-        # global), then trials train/evaluate concurrently — one thread per
-        # mesh device, each on its own 1-device mesh, so an 8-device mesh
-        # runs 8 trials at a time instead of leaving 7 devices idle.
-        builds = []
-        for i in range(max_evals):
-            params = sample_space(search_space, rng)
-            trial_model = model(params)
+        # Params are sampled up-front (deterministic given seed); models are
+        # built lazily inside each trial under a lock (Keras layer-naming
+        # state is global) so only in-flight trials hold live models —
+        # memory stays O(concurrency + 1 best), not O(max_evals). Trials
+        # train/evaluate concurrently, one thread per mesh device, each on
+        # its own 1-device mesh, so an 8-device mesh runs 8 trials at a
+        # time instead of leaving 7 devices idle.
+        import threading
+
+        trial_params = [sample_space(search_space, rng) for _ in range(max_evals)]
+        build_lock = threading.Lock()
+        best_lock = threading.Lock()
+        best_state: dict = {"loss": float("inf"), "model": None}
+        # devices are leased from a free pool, not indexed by trial number —
+        # heterogeneous trial runtimes would otherwise double-book one
+        # device while its neighbor sits idle
+        import queue
+
+        free_devices: queue.Queue = queue.Queue()
+        for d in self.devices[: self.num_workers]:
+            free_devices.put(d)
+
+        def run_trial(i: int) -> Trial:
+            params = trial_params[i]
+            with build_lock:
+                trial_model = model(params)
             if getattr(trial_model, "optimizer", None) is None:
                 raise ValueError(
                     "model builder must return a compiled keras model"
                 )
-            builds.append((params, trial_model))
+            device = free_devices.get()
+            try:
+                return _train_on(device, i, params, trial_model)
+            finally:
+                free_devices.put(device)
 
-        def run_trial(i: int) -> Trial:
-            params, trial_model = builds[i]
-            device = self.devices[i % self.num_workers]
+        def _train_on(device, i: int, params: dict, trial_model) -> Trial:
             mesh = Mesh(np.array([device]), ("workers",))
             runner = MeshRunner(trial_model, "synchronous", "epoch", mesh)
             runner.run_epochs(
                 [(x_train, y_train)], epochs=epochs, batch_size=batch_size
             )
             results = runner.evaluate([(x_val, y_val)], batch_size=batch_size)
-            trial = Trial(
-                params=params,
-                loss=results["loss"],
-                metrics=results,
-                weights=trial_model.get_weights(),
-                model_json=trial_model.to_json(),
-            )
+            trial = Trial(params=params, loss=results["loss"], metrics=results)
+            with best_lock:
+                # keep only the running-best trained model (ties: first wins);
+                # losers are garbage-collected as their threads finish
+                if trial.loss < best_state["loss"]:
+                    best_state["loss"] = trial.loss
+                    best_state["model"] = trial_model
             if verbose:
                 logger.info(
                     "trial %d/%d: params=%s val_loss=%.4f",
@@ -179,11 +197,15 @@ class HyperParamModel:
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
             self.trials = list(pool.map(run_trial, range(max_evals)))
 
-        best = self.best_trial()
-        import keras
-
-        best_model = keras.models.model_from_json(best.model_json)
-        best_model.set_weights(best.weights)
+        # the trained model itself is returned — no json/weights round-trip,
+        # so builders using custom layers/objects work unchanged
+        best_model = best_state["model"]
+        if best_model is None:
+            raise RuntimeError(
+                f"no trial produced a finite validation loss "
+                f"(losses: {[t.loss for t in self.trials]}); the search "
+                f"space likely diverges — narrow the learning-rate range"
+            )
         self.best_models = [best_model]
         return best_model
 

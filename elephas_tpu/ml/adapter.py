@@ -12,7 +12,7 @@ import numpy as np
 from elephas_tpu.data.dataframe import DataFrame, vectorize_column
 from elephas_tpu.data.linalg import DenseVector
 from elephas_tpu.data.rdd import Rdd
-from elephas_tpu.utils.rdd_utils import encode_label, to_simple_rdd
+from elephas_tpu.utils.rdd_utils import encode_labels, to_simple_rdd
 
 
 def df_to_simple_rdd(
@@ -61,9 +61,7 @@ def from_data_frame(
     features = vectorize_column(df.column_values(features_col))
     raw = df.column_values(label_col)
     if categorical:
-        if nb_classes is None:
-            nb_classes = int(max(raw)) + 1
-        labels = np.stack([encode_label(l, nb_classes) for l in raw])
+        labels = encode_labels(raw, nb_classes)
     else:
         labels = np.asarray(raw, dtype=np.float32)
     return features, labels

@@ -223,8 +223,13 @@ class ElephasTransformer(_ElephasParams):
         config.pop("custom_objects", None)
         payload = {
             "transformer_config": config,
-            "weights": [w.tolist() for w in (self.weights or [])],
-            "weight_dtypes": [str(w.dtype) for w in (self.weights or [])],
+            # weights=None (untrained) must round-trip as None, not []
+            "weights": None
+            if self.weights is None
+            else [w.tolist() for w in self.weights],
+            "weight_dtypes": None
+            if self.weights is None
+            else [str(w.dtype) for w in self.weights],
         }
         with open(file_name, "w") as f:
             json.dump(payload, f)
@@ -243,10 +248,14 @@ def load_ml_estimator(file_name: str, custom_objects: dict | None = None) -> Ele
 def load_ml_transformer(file_name: str, custom_objects: dict | None = None) -> ElephasTransformer:
     with open(file_name) as f:
         payload = json.load(f)
-    weights = [
-        np.asarray(w, dtype=d)
-        for w, d in zip(payload["weights"], payload["weight_dtypes"])
-    ]
+    weights = (
+        None
+        if payload["weights"] is None
+        else [
+            np.asarray(w, dtype=d)
+            for w, d in zip(payload["weights"], payload["weight_dtypes"])
+        ]
+    )
     t = ElephasTransformer(weights=weights)
     t.set_config(payload["transformer_config"])
     if custom_objects is not None:

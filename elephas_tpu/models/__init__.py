@@ -1,0 +1,17 @@
+"""Model zoo covering the reference's example/benchmark configurations.
+
+The reference ships *examples*, not a model zoo — users hand
+``SparkModel`` an arbitrary compiled Keras model, and the example scripts
+(``[U] elephas examples/``: MNIST MLP, CIFAR-style convnets, IMDB LSTM)
+build those models inline. Here the same architectures are first-class
+builders so the benchmark suite (BASELINE.md configs 1–5) and the examples
+share one definition. All builders return *compiled* Keras-3 (jax backend)
+models ready to wrap in ``SparkModel``.
+"""
+
+from elephas_tpu.models.mlp import mnist_mlp
+from elephas_tpu.models.convnet import cifar10_cnn
+from elephas_tpu.models.lstm import imdb_lstm
+from elephas_tpu.models.resnet import resnet50, resnet
+
+__all__ = ["mnist_mlp", "cifar10_cnn", "imdb_lstm", "resnet50", "resnet"]

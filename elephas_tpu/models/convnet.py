@@ -1,0 +1,49 @@
+"""CIFAR-10 convnet — BASELINE config #2 (the async/hogwild benchmark).
+
+Matches the classic Keras CIFAR-10 CNN shape the reference's examples
+lineage uses: two conv blocks (32, 64 filters) with max-pooling and
+dropout, then a dense head. Channels-last NHWC — the layout XLA:TPU
+prefers for convolutions feeding the MXU.
+"""
+
+from __future__ import annotations
+
+
+def cifar10_cnn(
+    input_shape: tuple[int, int, int] = (32, 32, 3),
+    num_classes: int = 10,
+    lr: float = 1e-3,
+    sparse_labels: bool = True,
+    seed: int = 0,
+):
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    L = keras.layers
+    model = keras.Sequential(
+        [
+            L.Input(input_shape),
+            L.Conv2D(32, 3, padding="same", activation="relu"),
+            L.Conv2D(32, 3, activation="relu"),
+            L.MaxPooling2D(2),
+            L.Dropout(0.25),
+            L.Conv2D(64, 3, padding="same", activation="relu"),
+            L.Conv2D(64, 3, activation="relu"),
+            L.MaxPooling2D(2),
+            L.Dropout(0.25),
+            L.Flatten(),
+            L.Dense(512, activation="relu"),
+            L.Dropout(0.5),
+            L.Dense(num_classes, activation="softmax"),
+        ],
+        name="cifar10_cnn",
+    )
+    loss = (
+        "sparse_categorical_crossentropy"
+        if sparse_labels
+        else "categorical_crossentropy"
+    )
+    model.compile(
+        optimizer=keras.optimizers.Adam(lr), loss=loss, metrics=["accuracy"]
+    )
+    return model

@@ -1,0 +1,38 @@
+"""IMDB LSTM text classifier — BASELINE config #4 (sequence/embedding path).
+
+The classic Keras IMDB example the reference lineage demonstrates:
+Embedding → LSTM → sigmoid. Static ``maxlen`` keeps shapes fixed so the
+whole sequence model lowers through XLA (``lax.scan`` inside the LSTM cell)
+without retracing.
+"""
+
+from __future__ import annotations
+
+
+def imdb_lstm(
+    vocab_size: int = 20000,
+    maxlen: int = 80,
+    embed_dim: int = 128,
+    units: int = 128,
+    lr: float = 1e-3,
+    seed: int = 0,
+):
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    L = keras.layers
+    model = keras.Sequential(
+        [
+            L.Input((maxlen,), dtype="int32"),
+            L.Embedding(vocab_size, embed_dim),
+            L.LSTM(units, dropout=0.2, recurrent_dropout=0.0),
+            L.Dense(1, activation="sigmoid"),
+        ],
+        name="imdb_lstm",
+    )
+    model.compile(
+        optimizer=keras.optimizers.Adam(lr),
+        loss="binary_crossentropy",
+        metrics=["accuracy"],
+    )
+    return model

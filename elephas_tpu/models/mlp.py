@@ -1,0 +1,39 @@
+"""MNIST-style MLP — BASELINE config #1 (reference example
+``[U] elephas examples/mnist_mlp_spark.py``: 784→128→128→10 with dropout,
+categorical crossentropy)."""
+
+from __future__ import annotations
+
+
+def mnist_mlp(
+    input_dim: int = 784,
+    num_classes: int = 10,
+    hidden: int = 128,
+    dropout: float = 0.2,
+    lr: float = 1e-3,
+    sparse_labels: bool = True,
+    seed: int = 0,
+):
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((input_dim,)),
+            keras.layers.Dense(hidden, activation="relu"),
+            keras.layers.Dropout(dropout),
+            keras.layers.Dense(hidden, activation="relu"),
+            keras.layers.Dropout(dropout),
+            keras.layers.Dense(num_classes, activation="softmax"),
+        ],
+        name="mnist_mlp",
+    )
+    loss = (
+        "sparse_categorical_crossentropy"
+        if sparse_labels
+        else "categorical_crossentropy"
+    )
+    model.compile(
+        optimizer=keras.optimizers.Adam(lr), loss=loss, metrics=["accuracy"]
+    )
+    return model

@@ -1,0 +1,105 @@
+"""ResNet — BASELINE config #5 (the north-star throughput model).
+
+Implemented from scratch in Keras-3 functional style (no
+``keras.applications`` import, no pretrained-weight downloads — this
+environment has zero egress). Standard bottleneck-v1 design: 7×7/2 stem,
+max-pool, four stages of [3, 4, 6, 3] bottleneck blocks for ResNet-50.
+
+TPU notes:
+- NHWC channels-last, the layout XLA:TPU tiles onto the MXU.
+- ``dtype_policy='mixed_bfloat16'`` keeps conv/matmul compute in bf16
+  (MXU-native) with float32 variables and softmax.
+- BatchNorm statistics are non-trainable float state; the MeshRunner
+  ``pmean``s them across workers each sync (SURVEY.md §7 "hard parts").
+- A ``depths``/``width`` knob gives a tiny variant for CPU tests and the
+  multi-chip dry-run without touching the benchmark architecture.
+"""
+
+from __future__ import annotations
+
+
+def _bottleneck(x, filters: int, stride: int, name: str, L):
+    """Bottleneck residual block: 1×1 reduce → 3×3 → 1×1 expand (×4)."""
+    shortcut = x
+    if stride != 1 or x.shape[-1] != filters * 4:
+        shortcut = L.Conv2D(
+            filters * 4, 1, strides=stride, use_bias=False, name=name + "_sc_conv"
+        )(x)
+        shortcut = L.BatchNormalization(name=name + "_sc_bn")(shortcut)
+
+    y = L.Conv2D(filters, 1, use_bias=False, name=name + "_c1")(x)
+    y = L.BatchNormalization(name=name + "_bn1")(y)
+    y = L.Activation("relu", name=name + "_r1")(y)
+    y = L.Conv2D(
+        filters, 3, strides=stride, padding="same", use_bias=False, name=name + "_c2"
+    )(y)
+    y = L.BatchNormalization(name=name + "_bn2")(y)
+    y = L.Activation("relu", name=name + "_r2")(y)
+    y = L.Conv2D(filters * 4, 1, use_bias=False, name=name + "_c3")(y)
+    y = L.BatchNormalization(name=name + "_bn3")(y)
+    y = L.Add(name=name + "_add")([shortcut, y])
+    return L.Activation("relu", name=name + "_out")(y)
+
+
+def resnet(
+    input_shape: tuple[int, int, int] = (224, 224, 3),
+    num_classes: int = 1000,
+    depths: tuple[int, ...] = (3, 4, 6, 3),
+    width: int = 64,
+    lr: float = 0.1,
+    momentum: float = 0.9,
+    dtype_policy: str | None = None,
+    sparse_labels: bool = True,
+    seed: int = 0,
+    compile_model: bool = True,
+):
+    """General bottleneck ResNet; ``depths=(3,4,6,3), width=64`` = ResNet-50."""
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    prev_policy = keras.config.dtype_policy()
+    if dtype_policy is not None:
+        keras.config.set_dtype_policy(dtype_policy)
+    try:
+        L = keras.layers
+        inputs = keras.Input(input_shape)
+        x = L.Conv2D(
+            width, 7, strides=2, padding="same", use_bias=False, name="stem_conv"
+        )(inputs)
+        x = L.BatchNormalization(name="stem_bn")(x)
+        x = L.Activation("relu", name="stem_relu")(x)
+        x = L.MaxPooling2D(3, strides=2, padding="same", name="stem_pool")(x)
+        for stage, blocks in enumerate(depths):
+            filters = width * (2**stage)
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = _bottleneck(x, filters, stride, f"s{stage}_b{b}", L)
+        x = L.GlobalAveragePooling2D(name="avg_pool")(x)
+        x = L.Dense(num_classes, name="head")(x)
+        # softmax in float32 even under mixed_bfloat16 (numerics)
+        outputs = L.Activation("softmax", dtype="float32", name="probs")(x)
+        model = keras.Model(inputs, outputs, name=f"resnet{sum(depths) * 3 + 2}")
+    finally:
+        if dtype_policy is not None:
+            keras.config.set_dtype_policy(prev_policy)
+
+    if compile_model:
+        loss = (
+            "sparse_categorical_crossentropy"
+            if sparse_labels
+            else "categorical_crossentropy"
+        )
+        model.compile(
+            optimizer=keras.optimizers.SGD(lr, momentum=momentum),
+            loss=loss,
+            metrics=["accuracy"],
+        )
+    return model
+
+
+def resnet50(
+    input_shape: tuple[int, int, int] = (224, 224, 3),
+    num_classes: int = 1000,
+    **kwargs,
+):
+    return resnet(input_shape, num_classes, depths=(3, 4, 6, 3), width=64, **kwargs)

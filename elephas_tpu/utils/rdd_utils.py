@@ -26,6 +26,15 @@ def encode_label(label, nb_classes: int) -> np.ndarray:
     return encoded
 
 
+def encode_labels(raw, nb_classes: int | None = None) -> np.ndarray:
+    """One-hot a sequence of scalar labels (``nb_classes`` inferred as
+    max+1 when omitted) — the single label-encoding path shared by the
+    LabeledPoint and DataFrame adapters."""
+    if nb_classes is None:
+        nb_classes = int(max(raw)) + 1
+    return np.stack([encode_label(label, nb_classes) for label in raw])
+
+
 def to_simple_rdd(sc, features, labels, num_partitions: int | None = None) -> Rdd:
     """Zip feature and label arrays into an RDD of ``(x_row, y_row)`` pairs."""
     features = np.asarray(features)
@@ -54,9 +63,7 @@ def from_labeled_point(rdd: Rdd, categorical: bool = False, nb_classes: int | No
     points = rdd.collect()
     features = np.stack([p.features.toArray() for p in points]).astype(np.float32)
     if categorical:
-        if nb_classes is None:
-            nb_classes = int(max(p.label for p in points)) + 1
-        labels = np.stack([encode_label(p.label, nb_classes) for p in points])
+        labels = encode_labels([p.label for p in points], nb_classes)
     else:
         labels = np.array([p.label for p in points], dtype=np.float32)
     return features, labels

@@ -183,15 +183,26 @@ class MeshRunner:
 
         loss = self.model.loss
         if isinstance(loss, str):
-            return keras.losses.get(loss)  # plain function: per-sample values
-        if isinstance(loss, keras.losses.Loss):
-            return loss.call  # unreduced
-        if callable(loss):
-            return loss
-        raise ValueError(
-            f"unsupported loss spec {loss!r} (multi-output losses not yet "
-            "supported by the distributed evaluator)"
-        )
+            fn = keras.losses.get(loss)  # plain function: per-sample values
+        elif isinstance(loss, keras.losses.Loss):
+            fn = loss.call  # unreduced
+        elif callable(loss):
+            fn = loss
+        else:
+            raise ValueError(
+                f"unsupported loss spec {loss!r} (multi-output losses not yet "
+                "supported by the distributed evaluator)"
+            )
+
+        def aligned(y, y_pred):
+            # keras Loss.__call__ squeezes/expands rank-mismatched targets
+            # (e.g. binary y [B] vs y_pred [B,1]); raw loss fns don't
+            y = jnp.asarray(y)
+            if y.ndim == y_pred.ndim - 1 and y_pred.shape[-1] == 1:
+                y = y[..., None]
+            return fn(y, y_pred)
+
+        return aligned
 
     def _unwrapped_metrics(self, x_sample, y_sample):
         """Compiled metric objects, built and with CompileMetrics expanded.

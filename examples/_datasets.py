@@ -1,0 +1,66 @@
+"""Synthetic stand-ins for the reference examples' datasets.
+
+The reference examples download MNIST/CIFAR-10/IMDB via ``keras.datasets``;
+this environment has zero egress, so each generator produces a *learnable*
+synthetic task with the same shapes/dtypes — class-conditional patterns a
+small model trains above chance on within a couple of epochs, which is all
+the reference's loose end-task-quality assertions need (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synthetic_mnist(n: int = 6000, seed: int = 0):
+    """(n, 784) float32 in [0,1], 10 classes — per-class blob templates."""
+    rng = np.random.default_rng(seed)
+    templates = rng.uniform(0.0, 1.0, size=(10, 784)).astype(np.float32)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    x = templates[y] + rng.normal(0, 0.35, size=(n, 784)).astype(np.float32)
+    return np.clip(x, 0.0, 1.0), y
+
+
+def synthetic_cifar10(n: int = 4000, seed: int = 0):
+    """(n, 32, 32, 3) float32 in [0,1], 10 classes — colored texture blobs."""
+    rng = np.random.default_rng(seed)
+    templates = rng.uniform(0.0, 1.0, size=(10, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    x = templates[y] + rng.normal(0, 0.3, size=(n, 32, 32, 3)).astype(np.float32)
+    return np.clip(x, 0.0, 1.0), y
+
+
+def synthetic_imdb(n: int = 4000, vocab_size: int = 2000, maxlen: int = 80, seed: int = 0):
+    """(n, maxlen) int32 token ids, binary labels — class-biased unigrams.
+
+    Positive reviews draw tokens from the top half of the vocabulary more
+    often; an embedding+LSTM separates the classes easily.
+    """
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, size=n).astype(np.float32)
+    x = np.empty((n, maxlen), dtype=np.int32)
+    half = vocab_size // 2
+    for i in range(n):
+        if y[i] > 0.5:
+            hi = rng.integers(half, vocab_size, size=maxlen)
+            lo = rng.integers(1, half, size=maxlen)
+            mask = rng.random(maxlen) < 0.7
+        else:
+            hi = rng.integers(half, vocab_size, size=maxlen)
+            lo = rng.integers(1, half, size=maxlen)
+            mask = rng.random(maxlen) < 0.3
+        x[i] = np.where(mask, hi, lo)
+    return x, y
+
+
+def synthetic_imagenet(n: int = 1024, img: int = 224, num_classes: int = 1000, seed: int = 0):
+    """ImageNet-shaped random tensors (throughput benchmarking only)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, img, img, 3)).astype(np.float32)
+    y = rng.integers(0, num_classes, size=n).astype(np.int32)
+    return x, y
+
+
+def train_test_split(x, y, test_frac: float = 0.2):
+    n_test = int(len(x) * test_frac)
+    return (x[:-n_test], y[:-n_test]), (x[-n_test:], y[-n_test:])

@@ -1,0 +1,46 @@
+"""BASELINE config #2 — CIFAR-10-style ConvNet, asynchronous/hogwild mode.
+
+The reference's async path pushes weight deltas through a parameter server
+with the update lock elided (hogwild). Here the same staleness-tolerant
+semantics compile to periodic in-XLA weight averaging (see
+elephas_tpu/worker.py mode notes).
+"""
+
+import argparse
+
+from elephas_tpu import SparkModel
+from elephas_tpu.data import SparkContext
+from elephas_tpu.models import cifar10_cnn
+from elephas_tpu.utils.rdd_utils import to_simple_rdd
+
+from _datasets import synthetic_cifar10, train_test_split
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--frequency", choices=["epoch", "batch"], default="epoch")
+    p.add_argument("--workers", type=int, default=None)
+    args = p.parse_args()
+
+    (x_train, y_train), (x_test, y_test) = train_test_split(*synthetic_cifar10())
+
+    sc = SparkContext("local[*]")
+    rdd = to_simple_rdd(sc, x_train, y_train)
+
+    model = cifar10_cnn()
+    spark_model = SparkModel(
+        model, mode="hogwild", frequency=args.frequency, num_workers=args.workers
+    )
+    history = spark_model.fit(
+        rdd, epochs=args.epochs, batch_size=args.batch_size, verbose=1
+    )
+    print("train loss per epoch:", [round(v, 4) for v in history["loss"]])
+
+    loss, acc = spark_model.evaluate(x_test, y_test, batch_size=args.batch_size)
+    print(f"test loss={loss:.4f} acc={acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

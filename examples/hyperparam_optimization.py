@@ -1,0 +1,67 @@
+"""Distributed hyperparameter search — HyperParamModel.
+
+Mirrors the reference's hyperas example (``[U] elephas
+examples/hyperparam_optimization.py``), with the hyperas templating
+replaced by a plain builder + search-space DSL; trials run concurrently
+across mesh devices.
+"""
+
+import argparse
+
+import keras
+
+from elephas_tpu import HyperParamModel
+from elephas_tpu.data import SparkContext
+from elephas_tpu.hyperparam import choice, loguniform, quniform
+
+from _datasets import synthetic_mnist, train_test_split
+
+
+def build_model(params):
+    model = keras.Sequential(
+        [
+            keras.layers.Input((784,)),
+            keras.layers.Dense(int(params["units"]), activation="relu"),
+            keras.layers.Dropout(params["dropout"]),
+            keras.layers.Dense(10, activation="softmax"),
+        ]
+    )
+    model.compile(
+        optimizer=keras.optimizers.Adam(params["lr"]),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    return model
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--max-evals", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=2)
+    args = p.parse_args()
+
+    (x_train, y_train), (x_val, y_val) = train_test_split(*synthetic_mnist(3000))
+
+    sc = SparkContext("local[*]")
+    hyperparam_model = HyperParamModel(sc, seed=0)
+    best = hyperparam_model.minimize(
+        model=build_model,
+        data=(x_train, y_train, x_val, y_val),
+        max_evals=args.max_evals,
+        search_space={
+            "units": quniform(32, 128, 32),
+            "dropout": choice([0.0, 0.2, 0.5]),
+            "lr": loguniform(1e-4, 1e-2),
+        },
+        epochs=args.epochs,
+        batch_size=64,
+        verbose=1,
+    )
+    print("best params:", hyperparam_model.best_model_params())
+    print("best val loss:", round(hyperparam_model.best_trial().loss, 4))
+    loss, acc = best.evaluate(x_val, y_val, verbose=0)
+    print(f"best model val acc: {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

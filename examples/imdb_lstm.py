@@ -1,0 +1,45 @@
+"""BASELINE config #4 — IMDB-style LSTM text classification via SparkModel.
+
+The sequence/embedding path: Embedding → LSTM → sigmoid, whole sequences
+per worker (the reference trains these the same way — SURVEY.md §5
+"long-context: absent in reference"). The LSTM recurrence lowers to
+``lax.scan`` inside the one compiled epoch program.
+"""
+
+import argparse
+
+from elephas_tpu import SparkModel
+from elephas_tpu.data import SparkContext
+from elephas_tpu.models import imdb_lstm
+from elephas_tpu.utils.rdd_utils import to_simple_rdd
+
+from _datasets import synthetic_imdb, train_test_split
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--maxlen", type=int, default=80)
+    p.add_argument("--vocab", type=int, default=2000)
+    args = p.parse_args()
+
+    x, y = synthetic_imdb(vocab_size=args.vocab, maxlen=args.maxlen)
+    (x_train, y_train), (x_test, y_test) = train_test_split(x, y)
+
+    sc = SparkContext("local[*]")
+    rdd = to_simple_rdd(sc, x_train, y_train)
+
+    model = imdb_lstm(vocab_size=args.vocab, maxlen=args.maxlen, embed_dim=64, units=64)
+    spark_model = SparkModel(model, mode="synchronous")
+    history = spark_model.fit(
+        rdd, epochs=args.epochs, batch_size=args.batch_size, verbose=1
+    )
+    print("train loss per epoch:", [round(v, 4) for v in history["loss"]])
+
+    loss, acc = spark_model.evaluate(x_test, y_test, batch_size=args.batch_size)
+    print(f"test loss={loss:.4f} acc={acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

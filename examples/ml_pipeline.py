@@ -1,0 +1,70 @@
+"""BASELINE config #3 — ElephasEstimator in an ML Pipeline.
+
+Mirrors the reference's Otto-dataset pipeline example (``[U] elephas
+examples/ml_pipeline_otto.py``): DataFrame in → Pipeline(ElephasEstimator)
+→ fitted PipelineModel → transform adds a prediction column. Tabular
+binary classification on synthetic data.
+"""
+
+import argparse
+
+import keras
+import numpy as np
+
+from elephas_tpu.data.dataframe import SparkSession
+from elephas_tpu.ml import Pipeline
+from elephas_tpu.ml_model import ElephasEstimator
+
+
+def make_data(n=3000, d=20, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=d)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x @ w + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    args = p.parse_args()
+
+    x, y = make_data()
+    session = SparkSession()
+    df = session.createDataFrame(
+        [(row, float(label)) for row, label in zip(x, y)],
+        schema=["features", "label"],
+    )
+    train_df, test_df = df.randomSplit([0.8, 0.2], seed=1)
+
+    model = keras.Sequential(
+        [
+            keras.layers.Input((x.shape[1],)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(2, activation="softmax"),
+        ]
+    )
+    estimator = ElephasEstimator(
+        keras_model_config=model.to_json(),
+        optimizer_config=keras.optimizers.serialize(keras.optimizers.Adam(1e-2)),
+        loss="categorical_crossentropy",
+        metrics=["accuracy"],
+        categorical_labels=True,
+        nb_classes=2,
+        epochs=args.epochs,
+        batch_size=64,
+        mode="synchronous",
+        predict_classes=True,
+    )
+
+    pipeline = Pipeline(stages=[estimator])
+    fitted = pipeline.fit(train_df)
+    out = fitted.transform(test_df)
+    rows = out.collect()
+    acc = float(np.mean([r.prediction == r.label for r in rows]))
+    print(f"pipeline test accuracy: {acc:.4f} ({len(rows)} rows)")
+    assert acc > 0.7
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""SparkMLlibModel on LabeledPoint RDDs (legacy MLlib API parity).
+
+Mirrors the reference's MLlib variant example: numpy → LabeledPoint RDD →
+``SparkMLlibModel.train`` with categorical one-hot conversion.
+"""
+
+import argparse
+
+from elephas_tpu import SparkMLlibModel
+from elephas_tpu.data import SparkContext
+from elephas_tpu.models import mnist_mlp
+from elephas_tpu.utils.rdd_utils import to_labeled_point
+
+from _datasets import synthetic_mnist, train_test_split
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    args = p.parse_args()
+
+    (x_train, y_train), (x_test, y_test) = train_test_split(*synthetic_mnist(3000))
+
+    sc = SparkContext("local[*]")
+    lp_rdd = to_labeled_point(sc, x_train, y_train, categorical=False)
+
+    model = mnist_mlp(input_dim=784, num_classes=10, sparse_labels=False)
+    spark_model = SparkMLlibModel(model, mode="synchronous")
+    spark_model.train(
+        lp_rdd, epochs=args.epochs, batch_size=64, categorical=True, nb_classes=10
+    )
+
+    preds = spark_model.predict(x_test)
+    acc = float((preds.argmax(axis=1) == y_test).mean())
+    print(f"test acc: {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""BASELINE config #1 — MNIST-style MLP via SparkModel(mode='synchronous').
+
+Mirrors the reference's flagship example (``[U] elephas
+examples/mnist_mlp_spark.py``): build+compile a Keras MLP, wrap it in
+``SparkModel``, train on a simple RDD, evaluate.
+"""
+
+import argparse
+
+from elephas_tpu import SparkModel
+from elephas_tpu.data import SparkContext
+from elephas_tpu.models import mnist_mlp
+from elephas_tpu.utils.rdd_utils import to_simple_rdd
+
+from _datasets import synthetic_mnist, train_test_split
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--workers", type=int, default=None)
+    args = p.parse_args()
+
+    (x_train, y_train), (x_test, y_test) = train_test_split(*synthetic_mnist())
+
+    sc = SparkContext("local[*]")
+    rdd = to_simple_rdd(sc, x_train, y_train)
+
+    model = mnist_mlp(input_dim=784, num_classes=10)
+    spark_model = SparkModel(model, mode="synchronous", num_workers=args.workers)
+    history = spark_model.fit(
+        rdd, epochs=args.epochs, batch_size=args.batch_size, verbose=1
+    )
+    print("train loss per epoch:", [round(v, 4) for v in history["loss"]])
+
+    loss, acc = spark_model.evaluate(x_test, y_test, batch_size=args.batch_size)
+    print(f"test loss={loss:.4f} acc={acc:.4f}")
+    assert acc > 0.7, "end-task quality below the reference's loose threshold"
+
+
+if __name__ == "__main__":
+    main()

@@ -61,3 +61,19 @@ def test_vectorize_column():
     arr = vectorize_column(col)
     assert arr.shape == (3, 2)
     assert arr.dtype == np.float32
+
+
+def test_row_eq_hash_with_numpy_fields():
+    """Rows holding numpy arrays (features columns) must compare/hash
+    without 'truth value of an array is ambiguous' errors."""
+    import numpy as np
+
+    from elephas_tpu.data.dataframe import Row
+
+    a = Row(features=np.array([1.0, 2.0]), label=1.0)
+    b = Row(features=np.array([1.0, 2.0]), label=1.0)
+    c = Row(features=np.array([9.0, 2.0]), label=1.0)
+    assert a == b
+    assert a != c
+    assert hash(a) == hash(b)
+    assert a in [c, b]

@@ -1,0 +1,49 @@
+"""The example scripts run end-to-end (reference keeps runnable examples;
+SURVEY.md §2 'Examples'). Fast configs only; heavy ones are covered by
+bench.py / their own CLIs."""
+
+import importlib
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+def run_example(name: str, argv: list[str]):
+    sys.path.insert(0, str(EXAMPLES))
+    old_argv = sys.argv
+    try:
+        module = importlib.import_module(name)
+        sys.argv = [name] + argv
+        module.main()
+    finally:
+        sys.argv = old_argv
+        sys.path.remove(str(EXAMPLES))
+
+
+def test_mnist_mlp_spark():
+    run_example("mnist_mlp_spark", ["--epochs", "3", "--batch-size", "64"])
+
+
+def test_ml_pipeline():
+    run_example("ml_pipeline", ["--epochs", "4"])
+
+
+def test_mllib_mlp():
+    run_example("mllib_mlp", ["--epochs", "2"])
+
+
+def test_hyperparam_optimization():
+    run_example("hyperparam_optimization", ["--max-evals", "3", "--epochs", "1"])
+
+
+@pytest.mark.slow
+def test_imdb_lstm():
+    run_example("imdb_lstm", ["--epochs", "1", "--maxlen", "20", "--vocab", "200"])
+
+
+@pytest.mark.slow
+def test_resnet50_tiny():
+    run_example("resnet50_imagenet", ["--tiny", "--epochs", "1"])

@@ -89,3 +89,30 @@ def test_uncompiled_builder_rejected(blobs):
     hp = HyperParamModel(num_workers=2)
     with pytest.raises(ValueError, match="compiled"):
         hp.minimize(build, (x, y, x, y), max_evals=1)
+
+
+def test_minimize_raises_on_divergent_search(blobs):
+    """All-NaN trials must raise a clear error, not return None."""
+    x, y, d, k = blobs
+
+    def nan_loss(y_true, y_pred):
+        # deterministic divergence: every trial's loss is NaN
+        return keras.ops.sum(y_pred, axis=-1) * float("nan")
+
+    def build(params):
+        model = keras.Sequential(
+            [
+                keras.layers.Input((d,)),
+                keras.layers.Dense(8, activation="relu"),
+                keras.layers.Dense(k, activation="softmax"),
+            ]
+        )
+        model.compile(optimizer=keras.optimizers.SGD(1e-2), loss=nan_loss)
+        return model
+
+    hp = HyperParamModel(num_workers=2, seed=0)
+    with pytest.raises(RuntimeError, match="finite validation loss"):
+        hp.minimize(
+            build, (x[:200], y[:200], x[200:300], y[200:300]),
+            max_evals=2, epochs=1, batch_size=32,
+        )

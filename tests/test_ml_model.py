@@ -141,3 +141,19 @@ def test_param_surface():
     est2 = ElephasEstimator()
     est2.set_config(cfg)
     assert est2.getFrequency() == "batch"
+
+
+def test_weightless_transformer_roundtrip(tmp_path, blobs):
+    """An untrained transformer (weights=None) survives save/load usable —
+    regression: [] vs None asymmetry made get_model() call set_weights([])."""
+    x, y, d, k = blobs
+    model = keras.Sequential(
+        [keras.layers.Input((d,)), keras.layers.Dense(k, activation="softmax")]
+    )
+    t = ElephasTransformer(keras_model_config=model.to_json())
+    path = str(tmp_path / "untrained.json")
+    t.save(path)
+    loaded = load_ml_transformer(path)
+    assert loaded.weights is None
+    rebuilt = loaded.get_model()  # must not raise
+    assert rebuilt.count_params() == model.count_params()

@@ -1,0 +1,79 @@
+"""Model zoo builders: shapes, compile state, and a tiny train step each."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.models import cifar10_cnn, imdb_lstm, mnist_mlp, resnet
+
+
+def test_mnist_mlp_shapes():
+    model = mnist_mlp(input_dim=20, num_classes=5, hidden=16)
+    out = model(np.zeros((3, 20), dtype=np.float32))
+    assert out.shape == (3, 5)
+    assert model.optimizer is not None
+
+
+def test_cifar10_cnn_shapes():
+    model = cifar10_cnn(input_shape=(32, 32, 3), num_classes=10)
+    out = model(np.zeros((2, 32, 32, 3), dtype=np.float32))
+    assert out.shape == (2, 10)
+
+
+def test_imdb_lstm_shapes():
+    model = imdb_lstm(vocab_size=50, maxlen=12, embed_dim=8, units=8)
+    out = model(np.zeros((2, 12), dtype=np.int32))
+    assert out.shape == (2, 1)
+
+
+def test_resnet_tiny_shapes_and_bn_state():
+    model = resnet(
+        input_shape=(32, 32, 3), num_classes=7, depths=(1, 1), width=8
+    )
+    out = model(np.zeros((2, 32, 32, 3), dtype=np.float32))
+    assert out.shape == (2, 7)
+    # batchnorm contributes non-trainable moving stats
+    assert len(model.non_trainable_variables) > 0
+
+
+def test_resnet50_architecture():
+    """ResNet-50 = 53 conv layers + 1 dense; ~25.6M params at 1000 classes."""
+    import keras
+
+    model = resnet(
+        input_shape=(64, 64, 3), num_classes=1000, compile_model=False
+    )
+    assert model.name == "resnet50"
+    convs = [l for l in model.layers if isinstance(l, keras.layers.Conv2D)]
+    assert len(convs) == 53
+    n_params = model.count_params()
+    assert 25_000_000 < n_params < 26_000_000, n_params
+
+
+@pytest.mark.parametrize(
+    "builder,x,y",
+    [
+        (
+            lambda: mnist_mlp(input_dim=10, num_classes=3, hidden=8),
+            np.random.default_rng(0).normal(size=(64, 10)).astype(np.float32),
+            np.random.default_rng(1).integers(0, 3, 64).astype(np.int32),
+        ),
+        (
+            lambda: resnet(
+                input_shape=(16, 16, 3), num_classes=3, depths=(1,), width=8
+            ),
+            np.random.default_rng(0).normal(size=(32, 16, 16, 3)).astype(np.float32),
+            np.random.default_rng(1).integers(0, 3, 32).astype(np.int32),
+        ),
+    ],
+)
+def test_zoo_model_trains_distributed(builder, x, y):
+    from elephas_tpu import SparkModel
+    from elephas_tpu.data import SparkContext
+    from elephas_tpu.utils.rdd_utils import to_simple_rdd
+
+    sc = SparkContext("local[4]")
+    rdd = to_simple_rdd(sc, x, y)
+    sm = SparkModel(builder(), mode="synchronous", num_workers=4)
+    history = sm.fit(rdd, epochs=2, batch_size=8)
+    assert len(history["loss"]) == 2
+    assert np.isfinite(history["loss"]).all()

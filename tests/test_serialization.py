@@ -26,3 +26,30 @@ def test_dict_is_plain_picklable():
     d2 = pickle.loads(pickle.dumps(d))
     clone = dict_to_model(d2)
     assert clone.count_params() > 0
+
+
+def test_wrong_keras_backend_fails_loud():
+    """Importing keras first under a non-jax backend must raise a clear
+    ImportError, not a tracer error deep inside fit."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os; os.environ['KERAS_BACKEND']='tensorflow'\n"
+        "import keras\n"
+        "try:\n"
+        "    import elephas_tpu\n"
+        "except ImportError as e:\n"
+        "    assert 'jax backend' in str(e), e\n"
+        "    print('GUARD_OK')\n"
+        "else:\n"
+        "    raise SystemExit('no ImportError raised')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={**__import__("os").environ, "PALLAS_AXON_POOL_IPS": ""},
+    )
+    assert "GUARD_OK" in out.stdout, out.stdout + out.stderr
