@@ -13,5 +13,14 @@ from elephas_tpu.models.mlp import mnist_mlp
 from elephas_tpu.models.convnet import cifar10_cnn
 from elephas_tpu.models.lstm import imdb_lstm
 from elephas_tpu.models.resnet import resnet50, resnet
+from elephas_tpu.models.transformer import transformer_classifier, transformer_lm
 
-__all__ = ["mnist_mlp", "cifar10_cnn", "imdb_lstm", "resnet50", "resnet"]
+__all__ = [
+    "mnist_mlp",
+    "cifar10_cnn",
+    "imdb_lstm",
+    "resnet50",
+    "resnet",
+    "transformer_classifier",
+    "transformer_lm",
+]

@@ -1,0 +1,18 @@
+"""Hand-written TPU kernels and distributed ops.
+
+The reference delegates all compute to TF kernels (SURVEY.md §2: "no
+native code in the reference itself — TF kernels in C++/CUDA are the
+delegated native layer"). Here the delegated layer is XLA, and this
+package holds the ops where hand-scheduling beats the compiler:
+
+- :mod:`elephas_tpu.ops.flash_attention` — blockwise online-softmax
+  attention (Pallas, MXU-tiled, O(S) memory).
+- :mod:`elephas_tpu.ops.ring_attention` — sequence-parallel attention
+  over a mesh axis via ``ppermute`` (KV blocks rotate over ICI while
+  each device computes its local query block).
+"""
+
+from elephas_tpu.ops.flash_attention import flash_attention
+from elephas_tpu.ops.ring_attention import ring_attention
+
+__all__ = ["flash_attention", "ring_attention"]

@@ -1,0 +1,296 @@
+"""Flash attention — blockwise online-softmax attention for TPU.
+
+Forward is a Pallas kernel (see /opt/skills/guides/pallas_guide.md):
+q/k/v blocks stream HBM→VMEM, scores hit the MXU tile-by-tile, and the
+softmax runs online (running max ``m``, normalizer ``l``, accumulator
+``acc`` live in VMEM scratch across the KV grid axis) — attention never
+materializes the ``[S, S]`` score matrix in HBM, so memory is O(S·D)
+instead of O(S²).
+
+Backward uses the standard flash recurrences (dV = Pᵀ dO, dS = P∘(dP − Δ),
+…) evaluated blockwise under ``lax.scan`` — O(S·D) residuals (just
+q/k/v/out/LSE), XLA-fused. The whole op carries a ``jax.custom_vjp`` so it
+drops into any ``jax.grad`` training step.
+
+On non-TPU backends the same kernel runs in Pallas interpreter mode
+(tests), keeping one code path.
+
+Reference parity note: the reference has no attention op of its own (its
+models call Keras layers); this op backs the transformer model family and
+the sequence-parallel path (ring_attention), which SURVEY.md §5 lists as
+absent upstream — a TPU-native extension, not a port.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+# -- forward kernel ----------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale: float, causal: bool, block_q: int, block_k: int):
+    j = pl.program_id(2)
+    last_j = pl.num_programs(2) - 1
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]  # [BQ, D]
+    k = k_ref[0]  # [BK, D]
+    s = jax.lax.dot_general(
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [BQ, BK]
+
+    if causal:
+        i = pl.program_id(1)
+        rows = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(cols <= rows, s, NEG_INF)
+
+    m_prev = m_ref[:]  # [BQ, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)  # [BQ, BK]
+    alpha = jnp.exp(m_prev - m_new)  # [BQ, 1]
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = m_new
+
+    @pl.when(j == last_j)
+    def _finalize():
+        l = l_ref[:]
+        # fully-masked rows (possible under causal padding) have l == 0
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = (m_ref[:] + jnp.log(safe_l))[:, 0]
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
+    """[BH, S, D] inputs → (out [BH, S, D], lse [BH, S])."""
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    if s_q % block_q or s_k % block_k:
+        raise ValueError(
+            f"sequence lengths ({s_q}, {s_k}) must be multiples of the "
+            f"block sizes ({block_q}, {block_k})"
+        )
+    grid = (bh, s_q // block_q, s_k // block_k)
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            # lse rides as [BH, 1, S] so the trailing block dims (1, block_q)
+            # meet Mosaic's (equal-dim, 128-divisible) tiling rule
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        # keras symbolic builds trace with a polymorphic batch dim
+        # (_DimExpr); CostEstimate requires concrete ints
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * s_q * s_k * d,
+            bytes_accessed=(2 * bh * s_q * d + 2 * bh * s_k * d) * q.dtype.itemsize,
+            transcendentals=bh * s_q * s_k,
+        )
+        if all(type(t) is int for t in (bh, s_q, s_k, d))
+        else None,
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[:, 0, :]
+
+
+# -- blockwise backward (flash recurrences, XLA-fused) ------------------
+
+
+def _causal_mask(i, j, block_q, block_k):
+    rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return cols <= rows
+
+
+def _flash_backward(scale, causal, block_q, block_k, residuals, g):
+    q, k, v, out, lse = residuals
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    nq, nk = s_q // block_q, s_k // block_k
+    f32 = jnp.float32
+
+    qb = q.reshape(bh, nq, block_q, d).astype(f32)
+    kb = k.reshape(bh, nk, block_k, d).astype(f32)
+    vb = v.reshape(bh, nk, block_k, d).astype(f32)
+    gb = g.reshape(bh, nq, block_q, d).astype(f32)
+    lseb = lse.reshape(bh, nq, block_q)
+    # Δ_i = rowsum(dO ∘ O)
+    delta = jnp.sum(g.astype(f32) * out.astype(f32), axis=-1).reshape(
+        bh, nq, block_q
+    )
+
+    def p_block(i, j, qi, kj, li):
+        s = jnp.einsum("bqd,bkd->bqk", qi, kj, preferred_element_type=f32) * scale
+        if causal:
+            s = jnp.where(_causal_mask(i, j, block_q, block_k)[None], s, NEG_INF)
+        return jnp.exp(s - li[..., None])  # [bh, BQ, BK]
+
+    # dq: for each query block, scan KV blocks
+    def dq_for_block(i, qi, gi, li, di):
+        def body(acc, j):
+            kj, vj = kb[:, j], vb[:, j]
+            p = p_block(i, j, qi, kj, li)
+            dp = jnp.einsum("bqd,bkd->bqk", gi, vj, preferred_element_type=f32)
+            ds = p * (dp - di[..., None])
+            return acc + jnp.einsum(
+                "bqk,bkd->bqd", ds, kj, preferred_element_type=f32
+            ) * scale, None
+
+        acc0 = jnp.zeros((bh, block_q, d), f32)
+        acc, _ = jax.lax.scan(body, acc0, jnp.arange(nk))
+        return acc
+
+    dq = jax.vmap(dq_for_block, in_axes=(0, 1, 1, 1, 1), out_axes=1)(
+        jnp.arange(nq), qb, gb, lseb, delta
+    ).reshape(bh, s_q, d)
+
+    # dk/dv: for each KV block, scan query blocks
+    def dkv_for_block(j, kj, vj):
+        def body(carry, i):
+            dk_acc, dv_acc = carry
+            qi, gi, li, di = qb[:, i], gb[:, i], lseb[:, i], delta[:, i]
+            p = p_block(i, j, qi, kj, li)
+            dv_acc = dv_acc + jnp.einsum(
+                "bqk,bqd->bkd", p, gi, preferred_element_type=f32
+            )
+            dp = jnp.einsum("bqd,bkd->bqk", gi, vj, preferred_element_type=f32)
+            ds = p * (dp - di[..., None])
+            dk_acc = dk_acc + jnp.einsum(
+                "bqk,bqd->bkd", ds, qi, preferred_element_type=f32
+            ) * scale
+            return (dk_acc, dv_acc), None
+
+        z = jnp.zeros((bh, block_k, d), f32)
+        (dk_acc, dv_acc), _ = jax.lax.scan(body, (z, z), jnp.arange(nq))
+        return dk_acc, dv_acc
+
+    dk, dv = jax.vmap(dkv_for_block, in_axes=(0, 1, 1), out_axes=1)(
+        jnp.arange(nk), kb, vb
+    )
+    dk = dk.reshape(bh, s_k, d)
+    dv = dv.reshape(bh, s_k, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# -- public op ---------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhsd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd_rule(scale, causal, block_q, block_k, interpret, residuals, g):
+    return _flash_backward(scale, causal, block_q, block_k, residuals, g)
+
+
+_flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+):
+    """Blockwise attention. ``q/k/v``: ``[batch, heads, seq, head_dim]``
+    (or ``[bh, seq, head_dim]``). Differentiable; O(seq) memory."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    squeeze = q.ndim == 3
+    if squeeze:
+        q, k, v = q[None], k[None], v[None]
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    merged = lambda t, s: t.reshape(b * h, s, d)  # noqa: E731
+    out = _flash_attention_bhsd(
+        merged(q, s_q),
+        merged(k, s_k),
+        merged(v, s_k),
+        float(scale),
+        bool(causal),
+        int(block_q),
+        int(block_k),
+        bool(interpret),
+    )
+    out = out.reshape(b, h, s_q, d)
+    return out[0] if squeeze else out
+
+
+def attention_reference(q, k, v, causal: bool = False, scale: float | None = None):
+    """Naive O(S²)-memory attention — the correctness oracle for tests."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = s.shape[-2], s.shape[-1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        s = jnp.where(cols <= rows, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,269 @@
+"""Tensor-parallel training over a 2-D ``('data', 'model')`` mesh.
+
+The reference is data-parallel only (SURVEY.md §2a); models there must
+fit one worker. This module removes that ceiling the idiomatic XLA way:
+parameters get :class:`~jax.sharding.NamedSharding` annotations over the
+``model`` axis (Megatron-style column/row splits for attention and MLP
+kernels, vocab-sharded embeddings), data is sharded over the ``data``
+axis, and one ``jax.jit`` train step lets GSPMD place the collectives
+(all-reduce over ``data`` for gradients, all-gather/reduce-scatter over
+``model`` where kernels are split) on ICI.
+
+Any spec the planner picks is numerically exact — GSPMD inserts whatever
+communication the layout implies — so the rule table is a performance
+knob, not a correctness risk. Unmatched variables replicate.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+# (variable-path regex, partition spec builder given model-axis name).
+# Megatron pairing: column-split the fan-out kernels (qkv, mlp up,
+# embeddings, lm head), row-split the fan-in kernels (attn proj, mlp
+# down) so the intermediate activations stay sharded between them.
+DEFAULT_RULES: list[tuple[str, callable]] = [
+    (r"(qkv|mlp1|lm_head|head)/kernel$", lambda m: P(None, m)),
+    (r"(proj|mlp2)/kernel$", lambda m: P(m, None)),
+    (r"embedding.*/embeddings$|tok_embed.*/embeddings$", lambda m: P(None, m)),
+    (r"dense[^/]*/kernel$", lambda m: P(None, m)),
+]
+
+
+def dp_tp_mesh(model_parallel: int = 1, data_parallel: int | None = None) -> Mesh:
+    """2-D mesh over the addressable devices: ``('data', 'model')``."""
+    devices = jax.devices()
+    if model_parallel <= 0 or len(devices) % model_parallel:
+        raise ValueError(
+            f"model_parallel={model_parallel} must divide the device count "
+            f"({len(devices)})"
+        )
+    dp = data_parallel or len(devices) // model_parallel
+    if dp * model_parallel > len(devices):
+        raise ValueError(
+            f"data_parallel×model_parallel = {dp}×{model_parallel} exceeds "
+            f"{len(devices)} devices"
+        )
+    arr = np.array(devices[: dp * model_parallel]).reshape(dp, model_parallel)
+    return Mesh(arr, ("data", "model"))
+
+
+def plan_sharding(
+    variables,
+    mesh: Mesh,
+    model_axis: str = "model",
+    rules=None,
+) -> list[NamedSharding]:
+    """Variable path → NamedSharding, first matching rule wins.
+
+    A rule only applies when the spec'd axes divide the variable's dims
+    on this mesh; otherwise the variable replicates (with a debug log) —
+    small odd-shaped layers aren't worth collective traffic anyway.
+    """
+    rules = rules if rules is not None else DEFAULT_RULES
+    axis_size = mesh.shape[model_axis]
+    out = []
+    for v in variables:
+        path = getattr(v, "path", getattr(v, "name", ""))
+        spec = P()
+        for pattern, build in rules:
+            if re.search(pattern, path):
+                candidate = build(model_axis)
+                ok = True
+                for dim, axes in zip(v.shape, candidate):
+                    if axes is not None and dim % axis_size:
+                        ok = False
+                if ok and len(candidate) <= len(v.shape):
+                    spec = candidate
+                else:
+                    logger.debug(
+                        "not sharding %s %s: %s does not tile", path, v.shape,
+                        candidate,
+                    )
+                break
+        out.append(NamedSharding(mesh, spec))
+    return out
+
+
+class ShardedTrainer:
+    """One-jit-program DP×TP trainer for a compiled Keras model.
+
+    The analogue of :class:`~elephas_tpu.worker.MeshRunner` for models
+    bigger than one chip: same stateless-Keras train math, but state
+    lives once (sharded), not stacked per worker, and synchronization is
+    implicit in the shardings.
+    """
+
+    def __init__(
+        self,
+        model,
+        mesh: Mesh | None = None,
+        model_parallel: int = 1,
+        rules=None,
+    ):
+        if getattr(model, "optimizer", None) is None:
+            raise ValueError("model must be compiled before sharded training")
+        self.model = model
+        self.mesh = mesh or dp_tp_mesh(model_parallel)
+        if "data" not in self.mesh.shape or "model" not in self.mesh.shape:
+            raise ValueError(
+                f"mesh must have ('data', 'model') axes, got {self.mesh.shape}"
+            )
+        model.optimizer.build(model.trainable_variables)
+        self._tv_sh = plan_sharding(model.trainable_variables, self.mesh, rules=rules)
+        self._ntv_sh = plan_sharding(
+            model.non_trainable_variables, self.mesh, rules=rules
+        )
+        # optimizer slots mirror their parameter's layout when shapes match
+        # (adam m/v etc.); scalar counters replicate
+        tv_by_shape = {}
+        for v, sh in zip(model.trainable_variables, self._tv_sh):
+            tv_by_shape.setdefault(tuple(v.shape), sh)
+        self._ov_sh = [
+            tv_by_shape.get(tuple(v.shape), NamedSharding(self.mesh, P()))
+            for v in model.optimizer.variables
+        ]
+        self._data_sh = NamedSharding(self.mesh, P("data"))
+        self._step_fn = None
+        self._eval_fn = None
+
+    # -- state ---------------------------------------------------------
+
+    def _device_state(self):
+        tv = [
+            jax.device_put(np.asarray(v.value), s)
+            for v, s in zip(self.model.trainable_variables, self._tv_sh)
+        ]
+        ntv = [
+            jax.device_put(np.asarray(v.value), s)
+            for v, s in zip(self.model.non_trainable_variables, self._ntv_sh)
+        ]
+        ov = [
+            jax.device_put(np.asarray(v.value), s)
+            for v, s in zip(self.model.optimizer.variables, self._ov_sh)
+        ]
+        return tv, ntv, ov
+
+    def _write_back(self, tv, ntv, ov):
+        for var, leaf in zip(self.model.trainable_variables, tv):
+            var.assign(np.asarray(jax.device_get(leaf)))
+        for var, leaf in zip(self.model.non_trainable_variables, ntv):
+            var.assign(np.asarray(jax.device_get(leaf)))
+        for var, leaf in zip(self.model.optimizer.variables, ov):
+            var.assign(np.asarray(jax.device_get(leaf)))
+
+    # -- compiled step -------------------------------------------------
+
+    def _build_step(self):
+        model = self.model
+        optimizer = model.optimizer
+
+        def loss_fn(tv, ntv, x, y):
+            y_pred, ntv2 = model.stateless_call(tv, ntv, x, training=True)
+            return model.compute_loss(x=x, y=y, y_pred=y_pred), ntv2
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def step(tv, ntv, ov, x, y):
+            (loss, ntv2), grads = grad_fn(tv, ntv, x, y)
+            tv2, ov2 = optimizer.stateless_apply(ov, grads, tv)
+            return tv2, ntv2, ov2, loss
+
+        return jax.jit(
+            step,
+            in_shardings=(
+                self._tv_sh,
+                self._ntv_sh,
+                self._ov_sh,
+                self._data_sh,
+                self._data_sh,
+            ),
+            out_shardings=(
+                self._tv_sh,
+                self._ntv_sh,
+                self._ov_sh,
+                NamedSharding(self.mesh, P()),
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+
+    def fit(self, x, y, epochs: int = 1, batch_size: int = 32, verbose: int = 0):
+        """Mini-batch training; returns a Keras-style history dict."""
+        x = np.asarray(x)
+        y = np.asarray(y)
+        dp = self.mesh.shape["data"]
+        # batch must tile the data axis
+        batch_size = max(dp, (batch_size // dp) * dp)
+        nb = max(1, len(x) // batch_size)
+        usable = nb * batch_size
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        tv, ntv, ov = self._device_state()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            losses = []
+            for b in range(nb):
+                xb = jax.device_put(
+                    x[b * batch_size : (b + 1) * batch_size], self._data_sh
+                )
+                yb = jax.device_put(
+                    y[b * batch_size : (b + 1) * batch_size], self._data_sh
+                )
+                tv, ntv, ov, loss = self._step_fn(tv, ntv, ov, xb, yb)
+                losses.append(loss)
+            epoch_loss = float(np.mean([np.asarray(l) for l in losses]))
+            history["loss"].append(epoch_loss)
+            if verbose:
+                logger.info(
+                    "epoch %d/%d - loss %.4f (%d/%d rows used)",
+                    epoch + 1, epochs, epoch_loss, usable, len(x),
+                )
+        self._write_back(tv, ntv, ov)
+        return history
+
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        model = self.model
+        if self._eval_fn is None:
+            def forward(tv, ntv, x):
+                y_pred, _ = model.stateless_call(tv, ntv, x, training=False)
+                return y_pred
+
+            self._eval_fn = jax.jit(
+                forward, in_shardings=(self._tv_sh, self._ntv_sh, self._data_sh)
+            )
+        tv = [
+            jax.device_put(np.asarray(v.value), s)
+            for v, s in zip(model.trainable_variables, self._tv_sh)
+        ]
+        ntv = [
+            jax.device_put(np.asarray(v.value), s)
+            for v, s in zip(model.non_trainable_variables, self._ntv_sh)
+        ]
+        dp = self.mesh.shape["data"]
+        x = np.asarray(x)
+        n = len(x)
+        pad = (-n) % dp
+        if pad:
+            # repeat the last row — safe even when n < pad
+            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)])
+        out = np.asarray(
+            jax.device_get(self._eval_fn(tv, ntv, jax.device_put(x, self._data_sh)))
+        )
+        return out[:n]
+
+    def sharding_summary(self) -> dict[str, str]:
+        """Variable path → partition spec (for tests/debugging)."""
+        return {
+            getattr(v, "path", str(i)): str(s.spec)
+            for i, (v, s) in enumerate(
+                zip(self.model.trainable_variables, self._tv_sh)
+            )
+        }

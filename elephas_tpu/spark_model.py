@@ -138,22 +138,70 @@ class SparkModel:
         batch_size: int | None = None,
         verbose: int = 0,
         validation_split: float = 0.0,
+        profile_dir: str | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+        resume: bool = False,
         **kwargs,
     ) -> dict:
         """Train on a simple RDD of ``(x_row, y_row)`` pairs; returns the
-        Keras-style history dict (also appended to ``training_histories``)."""
+        Keras-style history dict (also appended to ``training_histories``).
+
+        Beyond the reference's surface (SURVEY.md §5):
+
+        - ``profile_dir``: capture a ``jax.profiler`` trace of the compiled
+          epochs (view in TensorBoard/Perfetto).
+        - ``checkpoint_dir``/``checkpoint_every``: snapshot model+optimizer
+          every N epochs; ``resume=True`` restarts from the latest
+          snapshot, training only the remaining epochs.
+        """
         batch_size = batch_size or self.batch_size
         if rdd.getNumPartitions() != self.num_workers:
             rdd = rdd.repartition(self.num_workers)
         partitions = rdd_utils.partition_arrays(rdd)
         return self._fit_partitions(
-            partitions, epochs, batch_size, verbose, validation_split
+            partitions,
+            epochs,
+            batch_size,
+            verbose,
+            validation_split,
+            profile_dir=profile_dir,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            resume=resume,
         )
 
     def _fit_partitions(
-        self, partitions, epochs, batch_size, verbose=0, validation_split=0.0
+        self,
+        partitions,
+        epochs,
+        batch_size,
+        verbose=0,
+        validation_split=0.0,
+        profile_dir=None,
+        checkpoint_dir=None,
+        checkpoint_every=1,
+        resume=False,
     ) -> dict:
         runner = self._get_runner()
+
+        start_epoch = 0
+        if checkpoint_dir and resume:
+            from elephas_tpu.utils import checkpoint as ckpt
+
+            meta = ckpt.restore_checkpoint(
+                self._master_network, checkpoint_dir, self.custom_objects
+            )
+            if meta is not None:
+                start_epoch = int(meta["epoch"])
+                logger.info(
+                    "resuming from %s at epoch %d", checkpoint_dir, start_epoch
+                )
+        if start_epoch >= epochs:
+            history = {"loss": []}
+            self.training_histories.append(history)
+            return history
+        epochs = epochs - start_epoch
 
         val_partitions = None
         if validation_split and validation_split > 0.0:
@@ -173,9 +221,38 @@ class SparkModel:
                 # keep the external weight store live at epoch boundaries
                 # (run_epochs syncs the master model before each callback)
                 callbacks.append(lambda *_: self._publish_weights())
-            history = runner.run_epochs(
-                partitions, epochs, batch_size, verbose, callbacks=callbacks
-            )
+            if checkpoint_dir:
+                from elephas_tpu.utils import checkpoint as ckpt
+
+                def save_ckpt(epoch, _loss):
+                    done = start_epoch + epoch + 1
+                    if done % checkpoint_every == 0:
+                        ckpt.save_checkpoint(
+                            self._master_network, checkpoint_dir, done
+                        )
+
+                callbacks.append(save_ckpt)
+
+            if profile_dir:
+                import jax
+
+                trace_ctx = jax.profiler.trace(profile_dir)
+            else:
+                import contextlib
+
+                trace_ctx = contextlib.nullcontext()
+            with trace_ctx:
+                history = runner.run_epochs(
+                    partitions, epochs, batch_size, verbose, callbacks=callbacks
+                )
+            if checkpoint_dir:
+                # terminal snapshot regardless of checkpoint_every cadence
+                ckpt.save_checkpoint(
+                    self._master_network,
+                    checkpoint_dir,
+                    start_epoch + epochs,
+                    history,
+                )
             if val_partitions is not None:
                 val_results = runner.evaluate(val_partitions, batch_size)
                 for k, v in val_results.items():

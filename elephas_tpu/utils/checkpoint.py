@@ -1,0 +1,84 @@
+"""Mid-training checkpoint/resume.
+
+The reference has terminal-state persistence only (``SparkModel.save`` at
+the end; SURVEY.md §5 "checkpoint/resume") — a driver crash loses the run.
+TPU pods are gang-scheduled, so the honest failure-recovery story is
+checkpoint-restart: ``SparkModel.fit(checkpoint_dir=..., resume=True)``
+snapshots model + optimizer state at epoch boundaries and resumes from the
+latest snapshot after a restart.
+
+Format: one ``ckpt-<epoch>.keras`` archive (weights + optimizer state via
+Keras's saver) + a ``ckpt-<epoch>.json`` sidecar with epoch/history meta.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+_CKPT_RE = re.compile(r"ckpt-(\d+)\.keras$")
+
+
+def checkpoint_path(directory: str, epoch: int) -> str:
+    return os.path.join(directory, f"ckpt-{epoch:05d}.keras")
+
+
+def save_checkpoint(model, directory: str, epoch: int, history: dict | None = None) -> str:
+    """Snapshot ``model`` (incl. optimizer state) after ``epoch`` epochs."""
+    os.makedirs(directory, exist_ok=True)
+    path = checkpoint_path(directory, epoch)
+    model.save(path)
+    with open(path.replace(".keras", ".json"), "w") as f:
+        json.dump({"epoch": epoch, "history": history or {}}, f)
+    return path
+
+
+def latest_checkpoint(directory: str) -> tuple[str, dict] | None:
+    """Newest ``(path, meta)`` under ``directory``, or None."""
+    if not os.path.isdir(directory):
+        return None
+    best: tuple[int, str] | None = None
+    for name in os.listdir(directory):
+        m = _CKPT_RE.search(name)
+        if m:
+            epoch = int(m.group(1))
+            if best is None or epoch > best[0]:
+                best = (epoch, os.path.join(directory, name))
+    if best is None:
+        return None
+    meta_path = best[1].replace(".keras", ".json")
+    meta = {"epoch": best[0], "history": {}}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    return best[1], meta
+
+
+def restore_checkpoint(model, directory: str, custom_objects: dict | None = None) -> dict | None:
+    """Load the newest snapshot's weights + optimizer state into ``model``.
+
+    Returns the checkpoint meta (``{'epoch': ..., 'history': ...}``) or
+    None when no checkpoint exists. ``custom_objects`` as in
+    ``keras.models.load_model`` (layers registered via
+    ``keras.saving.register_keras_serializable`` — e.g. the zoo's
+    FlashMHA — need nothing here).
+    """
+    found = latest_checkpoint(directory)
+    if found is None:
+        return None
+    path, meta = found
+    import keras
+
+    loaded = keras.models.load_model(
+        path, compile=True, custom_objects=custom_objects
+    )
+    model.set_weights(loaded.get_weights())
+    if getattr(model, "optimizer", None) is not None and loaded.optimizer is not None:
+        model.optimizer.build(model.trainable_variables)
+        loaded_vars = loaded.optimizer.variables
+        own_vars = model.optimizer.variables
+        if len(loaded_vars) == len(own_vars):
+            for dst, src in zip(own_vars, loaded_vars):
+                dst.assign(src.value)
+    return meta

@@ -496,3 +496,166 @@ class MeshRunner:
                 a, b = xs[0][:1], ys[0][:1]
             out.append((a, b))
         return out
+
+
+# -- executor-side worker classes (reference API parity) ----------------
+
+
+class SparkWorker:
+    """Per-partition synchronous worker (``[U] elephas/worker.py::SparkWorker``).
+
+    The compiled SPMD path above supersedes this for normal training; these
+    classes are the reference-shaped escape hatch for custom per-partition
+    execution (and they are what the parameter-server protocol tests drive).
+    ``train(data_iterator)`` yields ``(trained_weights, history_dict)`` —
+    the v3-lineage contract (SURVEY.md §2 "SparkWorker").
+    """
+
+    def __init__(
+        self,
+        json_model: str,
+        parameters,
+        train_config: dict | None = None,
+        master_optimizer="rmsprop",
+        master_loss="categorical_crossentropy",
+        master_metrics=None,
+        custom_objects: dict | None = None,
+    ):
+        self.json_model = json_model
+        self.parameters = parameters
+        self.train_config = dict(train_config or {})
+        self.master_optimizer = master_optimizer
+        self.master_loss = master_loss
+        self.master_metrics = master_metrics
+        self.custom_objects = custom_objects
+
+    def _build(self):
+        import keras
+
+        model = keras.models.model_from_json(
+            self.json_model, custom_objects=self.custom_objects
+        )
+        model.compile(
+            optimizer=self.master_optimizer,
+            loss=self.master_loss,
+            metrics=self.master_metrics,
+        )
+        if self.parameters is not None:
+            model.set_weights(self.parameters)
+        return model
+
+    @staticmethod
+    def _stack(data_iterator):
+        xs, ys = [], []
+        for x, y in data_iterator:
+            xs.append(np.asarray(x))
+            ys.append(np.asarray(y))
+        if not xs:
+            return None, None
+        return np.stack(xs), np.stack(ys)
+
+    def train(self, data_iterator):
+        """Train on one partition's rows; yields (weights, history)."""
+        x, y = self._stack(data_iterator)
+        if x is None:
+            return
+        model = self._build()
+        history = model.fit(
+            x,
+            y,
+            epochs=self.train_config.get("epochs", 1),
+            batch_size=self.train_config.get("batch_size", 32),
+            verbose=self.train_config.get("verbose", 0),
+            validation_split=self.train_config.get("validation_split", 0.0),
+        )
+        yield model.get_weights(), history.history
+
+
+class AsynchronousSparkWorker(SparkWorker):
+    """Per-partition async worker: pull → local train → push delta
+    (``[U] elephas/worker.py::AsynchronousSparkWorker``).
+
+    Speaks the real parameter-server protocol through a
+    :mod:`elephas_tpu.parameter` client, so it works against a weight
+    store on another host over DCN. ``frequency='epoch'`` syncs once per
+    epoch, ``'batch'`` once per mini-batch.
+    """
+
+    def __init__(
+        self,
+        json_model: str,
+        parameters=None,
+        train_config: dict | None = None,
+        frequency: str = "epoch",
+        parameter_server_mode: str = "http",
+        master: str | None = None,
+        port: int = 4000,
+        master_optimizer="rmsprop",
+        master_loss="categorical_crossentropy",
+        master_metrics=None,
+        custom_objects: dict | None = None,
+    ):
+        super().__init__(
+            json_model,
+            parameters,
+            train_config,
+            master_optimizer,
+            master_loss,
+            master_metrics,
+            custom_objects,
+        )
+        if frequency not in ("epoch", "batch"):
+            raise ValueError(f"frequency must be 'epoch' or 'batch', got {frequency!r}")
+        self.frequency = frequency
+        self.parameter_server_mode = parameter_server_mode
+        self.master = master
+        self.port = port
+
+    def _client(self):
+        from elephas_tpu.parameter.client import HttpClient, SocketClient
+
+        cls = {"http": HttpClient, "socket": SocketClient}.get(
+            self.parameter_server_mode
+        )
+        if cls is None:
+            raise ValueError(
+                f"parameter_server_mode must be 'http' or 'socket', "
+                f"got {self.parameter_server_mode!r}"
+            )
+        return cls(self.master, self.port)
+
+    def train(self, data_iterator):
+        from elephas_tpu.utils.functional_utils import subtract_params
+
+        x, y = self._stack(data_iterator)
+        if x is None:
+            return
+        model = self._build()
+        client = self._client()
+        epochs = self.train_config.get("epochs", 1)
+        batch_size = self.train_config.get("batch_size", 32)
+        try:
+            for _ in range(epochs):
+                if self.frequency == "epoch":
+                    before = client.get_parameters()
+                    model.set_weights(before)
+                    model.fit(x, y, epochs=1, batch_size=batch_size, verbose=0)
+                    # server applies weights += delta, so the delta must be
+                    # the descent step (after − before)
+                    client.update_parameters(
+                        subtract_params(model.get_weights(), before)
+                    )
+                else:  # per-batch
+                    for start in range(0, len(x), batch_size):
+                        xb = x[start : start + batch_size]
+                        yb = y[start : start + batch_size]
+                        before = client.get_parameters()
+                        model.set_weights(before)
+                        model.train_on_batch(xb, yb)
+                        client.update_parameters(
+                            subtract_params(model.get_weights(), before)
+                        )
+        finally:
+            if hasattr(client, "close"):
+                client.close()
+        yield model.get_weights(), {}
