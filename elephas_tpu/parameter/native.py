@@ -1,0 +1,228 @@
+"""ctypes bindings for the native (C++) parameter-server weight store.
+
+Same public surface as the pure-Python servers/clients in
+:mod:`elephas_tpu.parameter.server`/``client`` (get/update/set, start/
+stop), but the store, the update loop, and the wire format are native:
+raw float32 buffers over TCP, in-place vectorized adds, a mutex for
+``asynchronous`` mode and none for ``hogwild`` — the reference's
+semantics without the reference's pickle tax.
+
+The shared library compiles on first use with the system ``g++`` (cached
+next to the source); environments without a toolchain raise a clear
+error and can fall back to the Python servers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "ps_server.cpp",
+)
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _lib_path() -> str:
+    """Cache dir outside the source tree, keyed on the source hash —
+    survives installed/read-only packages, never loads a stale or
+    foreign-arch binary (content hash changes → new file)."""
+    import hashlib
+    import platform
+    import tempfile
+
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    cache_dir = os.path.join(cache_root, "elephas_tpu")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        cache_dir = tempfile.gettempdir()
+    return os.path.join(cache_dir, f"libeps-{platform.machine()}-{digest}.so")
+
+
+def _load_library():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib_path = _lib_path()
+        if not os.path.exists(lib_path):
+            cmd = [
+                "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                _SRC, "-o", lib_path,
+            ]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+            except FileNotFoundError as e:
+                raise RuntimeError(
+                    "native parameter server needs g++; use the Python "
+                    "servers (parameter_server_mode='http'/'socket') instead"
+                ) from e
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+        lib = ctypes.CDLL(lib_path)
+        lib.eps_server_create.restype = ctypes.c_void_p
+        lib.eps_server_create.argtypes = [
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.eps_server_port.restype = ctypes.c_int
+        lib.eps_server_port.argtypes = [ctypes.c_void_p]
+        lib.eps_server_set.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
+        ]
+        lib.eps_server_get.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
+        ]
+        lib.eps_server_stop.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class _Flattener:
+    """Weight list <-> one contiguous float32 vector.
+
+    The wire/store format is float32 only; anything float32 can't carry
+    exactly (float64, int tensors) is rejected loudly rather than
+    silently rounded — the pickle servers preserve those dtypes.
+    """
+
+    def __init__(self, weights):
+        self.shapes = [np.asarray(w).shape for w in weights]
+        self.dtypes = [np.asarray(w).dtype for w in weights]
+        bad = [
+            str(d)
+            for d in self.dtypes
+            if not (np.issubdtype(d, np.floating) and d.itemsize <= 4)
+        ]
+        if bad:
+            raise ValueError(
+                f"native parameter server stores float32 only; weight "
+                f"dtypes {bad} would lose precision — use "
+                f"parameter_server_mode='http' or 'socket' for this model"
+            )
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.total = sum(self.sizes)
+
+    def flatten(self, weights) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(w, dtype=np.float32).ravel() for w in weights]
+        ) if weights else np.zeros(0, np.float32)
+
+    def unflatten(self, flat: np.ndarray):
+        out, offset = [], 0
+        for shape, dtype, size in zip(self.shapes, self.dtypes, self.sizes):
+            out.append(flat[offset : offset + size].reshape(shape).astype(dtype))
+            offset += size
+        return out
+
+
+class NativeParameterServer:
+    """Drop-in for ``HttpServer``/``SocketServer`` with a native core."""
+
+    def __init__(self, weights, mode: str = "asynchronous", port: int = 0):
+        self._lib = _load_library()
+        self._flat = _Flattener(weights)
+        use_lock = 0 if mode == "hogwild" else 1
+        self._handle = self._lib.eps_server_create(
+            self._flat.total, use_lock, port
+        )
+        if not self._handle:
+            raise OSError(f"native parameter server failed to bind port {port}")
+        self.port = self._lib.eps_server_port(self._handle)
+        self.set_weights(weights)
+
+    def start(self) -> None:  # the C++ accept loop starts at create
+        pass
+
+    def set_weights(self, weights) -> None:
+        flat = np.ascontiguousarray(self._flat.flatten(weights))
+        self._lib.eps_server_set(
+            self._handle,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            flat.size,
+        )
+
+    def get_parameters(self):
+        flat = np.empty(self._flat.total, np.float32)
+        self._lib.eps_server_get(
+            self._handle,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            flat.size,
+        )
+        return self._flat.unflatten(flat)
+
+    def update_parameters(self, delta) -> None:
+        client = NativeClient("127.0.0.1", self.port, self._flat)
+        try:
+            client.update_parameters(delta)
+        finally:
+            client.close()
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.eps_server_stop(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class NativeClient:
+    """Binary-protocol client (usable against the C++ server from any
+    host; carries a ``_Flattener`` built from the model's weight spec)."""
+
+    def __init__(self, host: str, port: int, flattener: _Flattener):
+        self._flat = flattener
+        self._sock = socket.create_connection((host, port))
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("native PS connection closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def get_parameters(self):
+        self._sock.sendall(b"g")
+        (nbytes,) = struct.unpack("<Q", self._recv_exact(8))
+        flat = np.frombuffer(self._recv_exact(nbytes), dtype=np.float32)
+        return self._flat.unflatten(flat)
+
+    def _send_buffer(self, op: bytes, weights) -> None:
+        flat = np.ascontiguousarray(self._flat.flatten(weights))
+        self._sock.sendall(
+            op + struct.pack("<Q", flat.nbytes) + flat.tobytes()
+        )
+        assert self._recv_exact(1) == b"k"
+
+    def update_parameters(self, delta) -> None:
+        self._send_buffer(b"u", delta)
+
+    def set_parameters(self, weights) -> None:
+        self._send_buffer(b"s", weights)
+
+    def close(self) -> None:
+        try:
+            self._sock.sendall(b"q")
+        except OSError:
+            pass
+        self._sock.close()

@@ -110,10 +110,14 @@ class SparkModel:
         cls = {"http": HttpServer, "socket": SocketServer}.get(
             self.parameter_server_mode
         )
+        if cls is None and self.parameter_server_mode == "native":
+            from elephas_tpu.parameter.native import NativeParameterServer
+
+            cls = NativeParameterServer
         if cls is None:
             raise ValueError(
-                f"parameter_server_mode must be 'http', 'socket' or None, "
-                f"got {self.parameter_server_mode!r}"
+                f"parameter_server_mode must be 'http', 'socket', 'native' "
+                f"or None, got {self.parameter_server_mode!r}"
             )
         self._parameter_server = cls(
             self._master_network.get_weights(), mode=self.mode, port=self.port

@@ -611,16 +611,22 @@ class AsynchronousSparkWorker(SparkWorker):
         self.master = master
         self.port = port
 
-    def _client(self):
+    def _client(self, model=None):
         from elephas_tpu.parameter.client import HttpClient, SocketClient
 
+        if self.parameter_server_mode == "native":
+            from elephas_tpu.parameter.native import NativeClient, _Flattener
+
+            host, _, p = (self.master or "127.0.0.1").partition(":")
+            port = int(p) if p else self.port
+            return NativeClient(host, port, _Flattener(model.get_weights()))
         cls = {"http": HttpClient, "socket": SocketClient}.get(
             self.parameter_server_mode
         )
         if cls is None:
             raise ValueError(
-                f"parameter_server_mode must be 'http' or 'socket', "
-                f"got {self.parameter_server_mode!r}"
+                f"parameter_server_mode must be 'http', 'socket' or "
+                f"'native', got {self.parameter_server_mode!r}"
             )
         return cls(self.master, self.port)
 
@@ -631,7 +637,7 @@ class AsynchronousSparkWorker(SparkWorker):
         if x is None:
             return
         model = self._build()
-        client = self._client()
+        client = self._client(model)
         epochs = self.train_config.get("epochs", 1)
         batch_size = self.train_config.get("batch_size", 32)
         try:
