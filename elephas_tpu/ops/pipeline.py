@@ -1,0 +1,96 @@
+"""Pipeline parallelism — GPipe-style SPMD pipeline over a mesh axis.
+
+Absent from the reference (SURVEY.md §2a); provided as the TPU-native
+construction used for stacks of identical blocks (the realistic PP case:
+a transformer's repeated layers). Stage parameters are sharded over a
+``('stages',)`` mesh axis — device ``s`` holds stage ``s``'s weights —
+and microbatches flow through the ring: each tick every device applies
+its stage to its current activation and hands the result to the next
+device via ``lax.ppermute`` (one neighbor hop on ICI). With ``M``
+microbatches and ``S`` stages the schedule runs ``M + S − 1`` ticks;
+the ``(S−1)/M`` bubble fraction is the standard GPipe cost, amortized by
+more microbatches.
+
+The whole schedule is a ``lax.scan`` inside ``shard_map`` — one compiled
+program, differentiable end-to-end (the backward pass pipelines in
+reverse through the transposed ``ppermute``s automatically).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gpipe(stage_fn, stage_params, x_microbatches, axis_name: str):
+    """Run microbatches through the stage pipeline; call INSIDE shard_map.
+
+    ``stage_fn(params, x) -> y`` applies one stage (same signature and
+    shapes for every stage; ``y.shape == x.shape``). ``stage_params`` is
+    this device's stage's params (the caller shards a stacked-[S, ...]
+    pytree over ``axis_name`` and passes the unstacked slice).
+    ``x_microbatches``: ``[M, mb, ...]`` (replicated — only stage 0 reads
+    it). Returns ``[M, mb, ...]`` outputs, replicated to all stages.
+    """
+    s = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    ticks = m + s - 1
+
+    def one_tick(carry, t):
+        recv, outputs = carry
+        mb_idx = jnp.clip(t, 0, m - 1)
+        inp = jnp.where(stage == 0, x_microbatches[mb_idx], recv)
+        out = stage_fn(stage_params, inp)
+        write_idx = t - (s - 1)
+        is_valid = (stage == s - 1) & (write_idx >= 0)
+        updated = outputs.at[jnp.clip(write_idx, 0, m - 1)].set(out)
+        outputs = jnp.where(is_valid, updated, outputs)
+        recv = jax.lax.ppermute(
+            out, axis_name, [(i, (i + 1) % s) for i in range(s)]
+        )
+        return (recv, outputs), None
+
+    recv0 = jnp.zeros_like(x_microbatches[0])
+    out0 = jnp.zeros_like(x_microbatches)
+    (recv, outputs), _ = jax.lax.scan(
+        one_tick, (recv0, out0), jnp.arange(ticks)
+    )
+    # results live on the last stage; replicate them to every stage
+    outputs = jnp.where(stage == s - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, axis_name)
+
+
+def gpipe_sharded(
+    stage_fn,
+    stacked_params,
+    x,
+    mesh,
+    num_microbatches: int,
+    axis_name: str = "stages",
+):
+    """Global-array wrapper: shards stacked ``[S, ...]`` stage params over
+    ``mesh[axis_name]``, splits ``x [B, ...]`` into microbatches, runs
+    :func:`gpipe`, and returns ``[B, ...]`` outputs."""
+    from jax.sharding import PartitionSpec as P
+
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} must divide into {num_microbatches} microbatches"
+        )
+    xm = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+    def fn(params_slice, xm):
+        params = jax.tree.map(lambda a: a[0], params_slice)
+        return gpipe(stage_fn, params, xm, axis_name)
+
+    sharded = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    out = sharded(stacked_params, xm)
+    return out.reshape((b,) + out.shape[2:])

@@ -1,0 +1,101 @@
+"""Expert-parallel MoE: EP result == single-device oracle, gradients
+flow, and load-imbalance capacity semantics hold."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from elephas_tpu.ops.moe import (
+    expert_parallel_ffn,
+    init_moe_params,
+    moe_ffn_reference,
+)
+
+W = 4  # mesh width used throughout
+
+
+def _setup(t_per_dev=32, d=16, h=32, e_local=2, seed=0):
+    key = jax.random.PRNGKey(seed)
+    e_total = W * e_local
+    params = init_moe_params(key, d, h, e_total)
+    x = jax.random.normal(jax.random.PRNGKey(seed + 1), (W * t_per_dev, d))
+    mesh = Mesh(np.array(jax.devices()[:W]), ("ep",))
+    return x, params, mesh, e_local
+
+
+def _run_ep(x, params, mesh, e_local, capacity_factor=1.25):
+    gate_w, w1, b1, w2, b2 = params
+
+    def fn(x, gate_w, w1, b1, w2, b2):
+        return expert_parallel_ffn(
+            x, gate_w, w1, b1, w2, b2, axis_name="ep",
+            capacity_factor=capacity_factor,
+        )
+
+    sharded = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep"), P("ep")),
+        out_specs=P("ep"),
+        check_vma=False,
+    )
+    return sharded(x, gate_w, w1, b1, w2, b2)
+
+
+def test_ep_matches_reference():
+    x, params, mesh, e_local = _setup()
+    out_ep = _run_ep(x, params, mesh, e_local)
+    out_ref = moe_ffn_reference(x, *params, num_shards=W)
+    np.testing.assert_allclose(
+        np.asarray(out_ep), np.asarray(out_ref), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_ep_gradients_flow():
+    x, params, mesh, e_local = _setup()
+
+    def loss_ep(x, params):
+        return jnp.sum(_run_ep(x, params, mesh, e_local) ** 2)
+
+    def loss_ref(x, params):
+        return jnp.sum(moe_ffn_reference(x, *params, num_shards=W) ** 2)
+
+    g_ep = jax.grad(loss_ep, argnums=(0, 1))(x, params)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(x, params)
+    flat_ep = jax.tree.leaves(g_ep)
+    flat_ref = jax.tree.leaves(g_ref)
+    for a, b in zip(flat_ep, flat_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
+    # expert weights actually receive gradient
+    assert any(float(jnp.abs(l).max()) > 0 for l in jax.tree.leaves(g_ep[1]))
+
+
+def test_capacity_drops_overflow():
+    """With capacity_factor → 0 every expert keeps ≤1 slot; most tokens
+    are dropped and the output collapses toward zero — the Switch
+    overflow contract, not an error."""
+    x, params, mesh, e_local = _setup()
+    out_tight = _run_ep(x, params, mesh, e_local, capacity_factor=1e-6)
+    out_roomy = _run_ep(x, params, mesh, e_local, capacity_factor=4.0)
+    zero_rows_tight = float(
+        jnp.mean(jnp.all(jnp.abs(out_tight) < 1e-12, axis=-1))
+    )
+    zero_rows_roomy = float(
+        jnp.mean(jnp.all(jnp.abs(out_roomy) < 1e-12, axis=-1))
+    )
+    assert zero_rows_tight > zero_rows_roomy
+    assert zero_rows_roomy < 0.05  # roomy capacity keeps ~all tokens
+
+
+def test_ep_composes_with_jit():
+    x, params, mesh, e_local = _setup()
+    jit_out = jax.jit(lambda x, p: _run_ep(x, p, mesh, e_local))(x, params)
+    np.testing.assert_allclose(
+        np.asarray(jit_out),
+        np.asarray(_run_ep(x, params, mesh, e_local)),
+        atol=1e-6,
+    )
