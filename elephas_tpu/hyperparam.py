@@ -129,6 +129,8 @@ class HyperParamModel:
         if callable(data):
             data = data()
         x_train, y_train, x_val, y_val = data
+        self._best_index = None  # cleared so a failed search can't pair a
+        # stale index with freshly assigned trials
         search_space = search_space or {}
         rng = np.random.default_rng(self.seed)
 
@@ -144,7 +146,7 @@ class HyperParamModel:
         trial_params = [sample_space(search_space, rng) for _ in range(max_evals)]
         build_lock = threading.Lock()
         best_lock = threading.Lock()
-        best_state: dict = {"loss": float("inf"), "model": None}
+        best_state: dict = {"loss": float("inf"), "model": None, "index": None}
         # devices are leased from a free pool, not indexed by trial number —
         # heterogeneous trial runtimes would otherwise double-book one
         # device while its neighbor sits idle
@@ -182,6 +184,7 @@ class HyperParamModel:
                 if trial.loss < best_state["loss"]:
                     best_state["loss"] = trial.loss
                     best_state["model"] = trial_model
+                    best_state["index"] = i
             if verbose:
                 logger.info(
                     "trial %d/%d: params=%s val_loss=%.4f",
@@ -207,11 +210,18 @@ class HyperParamModel:
                 f"space likely diverges — narrow the learning-rate range"
             )
         self.best_models = [best_model]
+        # the winning trial index is recorded at update time so that
+        # best_trial()/best_model_params() name the same trial the
+        # returned model came from, even on tied or NaN losses
+        self._best_index = best_state["index"]
         return best_model
 
     def best_trial(self) -> Trial:
         if not self.trials:
             raise ValueError("no trials run yet")
+        index = getattr(self, "_best_index", None)
+        if index is not None:
+            return self.trials[index]
         return min(self.trials, key=lambda t: t.loss)
 
     def best_model_params(self) -> dict:

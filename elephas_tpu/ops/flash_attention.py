@@ -70,6 +70,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     m_prev = m_ref[:]  # [BQ, 1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)  # [BQ, BK]
+    # fully-masked-so-far rows: m_new is still NEG_INF and s - m_new == 0
+    # would make p == 1, accumulating phantom mass (the row would output
+    # mean(V) instead of zeros). Zero p so l stays 0 for those rows.
+    p = jnp.where(m_new <= NEG_INF * 0.5, 0.0, p)
     alpha = jnp.exp(m_prev - m_new)  # [BQ, 1]
     l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -82,7 +86,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     @pl.when(j == last_j)
     def _finalize():
         l = l_ref[:]
-        # fully-masked rows (possible under causal padding) have l == 0
+        # fully-masked rows kept l == 0 via the p guard above; they output
+        # zeros with lse == NEG_INF (zero weight in ring-attention merges)
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
         lse_ref[0, 0, :] = (m_ref[:] + jnp.log(safe_l))[:, 0]
@@ -176,7 +181,9 @@ def _flash_backward(scale, causal, block_q, block_k, residuals, g):
         s = jnp.einsum("bqd,bkd->bqk", qi, kj, preferred_element_type=f32) * scale
         if causal:
             s = jnp.where(_causal_mask(i, j, block_q, block_k)[None], s, NEG_INF)
-        return jnp.exp(s - li[..., None])  # [bh, BQ, BK]
+        p = jnp.exp(s - li[..., None])  # [bh, BQ, BK]
+        # fully-masked rows carry lse == NEG_INF; exp(s - lse) would be 1
+        return jnp.where(li[..., None] <= NEG_INF * 0.5, 0.0, p)
 
     # dq: for each query block, scan KV blocks
     def dq_for_block(i, qi, gi, li, di):

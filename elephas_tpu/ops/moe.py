@@ -34,18 +34,21 @@ def _top1_dispatch(x, gate_w, num_experts: int, capacity: int):
     expert = jnp.argmax(probs, axis=-1)  # [T]
     prob = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [T]
 
-    onehot = jax.nn.one_hot(expert, num_experts, dtype=x.dtype)  # [T, E]
+    # routing math runs in int32 regardless of activation dtype: a
+    # bfloat16 cumsum goes inexact past 256 tokens, silently corrupting
+    # the capacity mask; only the final dispatch/combine cast to x.dtype
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)  # [T, E]
     # 0-based position of each token within its expert's queue (only the
     # token's own expert column is nonzero-capable)
     position = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [T, E]
     kept = (position < capacity) & (onehot > 0)
-    rank = jnp.sum(jnp.where(kept, position, 0.0), axis=-1)  # [T]
-    pos_onehot = jax.nn.one_hot(
-        rank.astype(jnp.int32), capacity, dtype=x.dtype
-    )  # [T, C]
+    rank = jnp.sum(jnp.where(kept, position, 0), axis=-1)  # [T] int32
+    pos_onehot = jax.nn.one_hot(rank, capacity, dtype=x.dtype)  # [T, C]
     keep_mask = jnp.any(kept, axis=-1).astype(x.dtype)  # [T]
     dispatch = (
-        onehot[:, :, None] * pos_onehot[:, None, :] * keep_mask[:, None, None]
+        onehot.astype(x.dtype)[:, :, None]
+        * pos_onehot[:, None, :]
+        * keep_mask[:, None, None]
     )
     combine = dispatch * prob[:, None, None]
     return dispatch, combine

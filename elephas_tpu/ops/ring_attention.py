@@ -104,6 +104,9 @@ def _chunk_grads(q, kc, vc, g, lse, delta, scale, mask):
     ) * scale
     s = jnp.where(mask[None], s, NEG_INF)
     p = jnp.exp(s - lse[..., None])  # zero where masked or skipped
+    # rows whose *global* lse is NEG_INF (every position masked) would
+    # otherwise get p = exp(NEG_INF - NEG_INF) = 1
+    p = jnp.where(lse[..., None] <= NEG_INF * 0.5, 0.0, p)
     dp = jnp.einsum(
         "bqd,bkd->bqk", g.astype(f32), vc.astype(f32), preferred_element_type=f32
     )

@@ -166,14 +166,18 @@ class ShardedTrainer:
         model = self.model
         optimizer = model.optimizer
 
-        def loss_fn(tv, ntv, x, y):
+        def loss_fn(tv, ntv, x, y, sw):
             y_pred, ntv2 = model.stateless_call(tv, ntv, x, training=True)
-            return model.compute_loss(x=x, y=y, y_pred=y_pred), ntv2
+            loss = model.compute_loss(x=x, y=y, y_pred=y_pred, sample_weight=sw)
+            # keras's sum_over_batch_size reduction divides by the full
+            # (padded) batch; rescale so a masked tail batch means exactly
+            # "mean over the valid rows"
+            return loss * (sw.size / jnp.maximum(jnp.sum(sw), 1.0)), ntv2
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-        def step(tv, ntv, ov, x, y):
-            (loss, ntv2), grads = grad_fn(tv, ntv, x, y)
+        def step(tv, ntv, ov, x, y, sw):
+            (loss, ntv2), grads = grad_fn(tv, ntv, x, y, sw)
             tv2, ov2 = optimizer.stateless_apply(ov, grads, tv)
             return tv2, ntv2, ov2, loss
 
@@ -183,6 +187,7 @@ class ShardedTrainer:
                 self._tv_sh,
                 self._ntv_sh,
                 self._ov_sh,
+                self._data_sh,
                 self._data_sh,
                 self._data_sh,
             ),
@@ -196,35 +201,67 @@ class ShardedTrainer:
         )
 
     def fit(self, x, y, epochs: int = 1, batch_size: int = 32, verbose: int = 0):
-        """Mini-batch training; returns a Keras-style history dict."""
+        """Mini-batch training; returns a Keras-style history dict.
+
+        Every row trains every epoch: the final partial batch is padded
+        to the fixed jit shape with repeated rows carrying zero sample
+        weight (one compiled program, no tail recompile, no dropped rows).
+        """
         x = np.asarray(x)
         y = np.asarray(y)
+        n = len(x)
         dp = self.mesh.shape["data"]
         # batch must tile the data axis
         batch_size = max(dp, (batch_size // dp) * dp)
-        nb = max(1, len(x) // batch_size)
-        usable = nb * batch_size
+        # full batches run unpadded; the tail batch is padded only up to
+        # the next multiple of dp (jit specializes once per shape, so the
+        # tail costs one extra compile, and <=dp-1 phantom rows touch the
+        # forward pass — zero-weighted in the loss, negligible in any
+        # batch statistics)
+        nb_full = n // batch_size
+        tail = n - nb_full * batch_size
+        tail_padded = -(-tail // dp) * dp if tail else 0
+        ones_sw = np.ones(batch_size, np.float32)
         if self._step_fn is None:
             self._step_fn = self._build_step()
         tv, ntv, ov = self._device_state()
         history = {"loss": []}
         for epoch in range(epochs):
-            losses = []
-            for b in range(nb):
-                xb = jax.device_put(
-                    x[b * batch_size : (b + 1) * batch_size], self._data_sh
+            losses: list[tuple] = []  # (device scalar, valid rows) — no
+            # host sync inside the loop; converted once per epoch
+            for b in range(nb_full):
+                lo = b * batch_size
+                tv, ntv, ov, loss = self._step_fn(
+                    tv, ntv, ov,
+                    jax.device_put(x[lo : lo + batch_size], self._data_sh),
+                    jax.device_put(y[lo : lo + batch_size], self._data_sh),
+                    jax.device_put(ones_sw, self._data_sh),
                 )
-                yb = jax.device_put(
-                    y[b * batch_size : (b + 1) * batch_size], self._data_sh
+                losses.append((loss, batch_size))
+            if tail:
+                lo = nb_full * batch_size
+                xb, yb = x[lo:], y[lo:]
+                pad = tail_padded - tail
+                if pad:
+                    xb = np.concatenate([xb, np.repeat(xb[-1:], pad, axis=0)])
+                    yb = np.concatenate([yb, np.repeat(yb[-1:], pad, axis=0)])
+                sw = np.zeros(tail_padded, np.float32)
+                sw[:tail] = 1.0
+                tv, ntv, ov, loss = self._step_fn(
+                    tv, ntv, ov,
+                    jax.device_put(xb, self._data_sh),
+                    jax.device_put(yb, self._data_sh),
+                    jax.device_put(sw, self._data_sh),
                 )
-                tv, ntv, ov, loss = self._step_fn(tv, ntv, ov, xb, yb)
-                losses.append(loss)
-            epoch_loss = float(np.mean([np.asarray(l) for l in losses]))
+                losses.append((loss, tail))
+            epoch_loss = (
+                sum(float(np.asarray(l)) * c for l, c in losses) / n
+            )
             history["loss"].append(epoch_loss)
             if verbose:
                 logger.info(
-                    "epoch %d/%d - loss %.4f (%d/%d rows used)",
-                    epoch + 1, epochs, epoch_loss, usable, len(x),
+                    "epoch %d/%d - loss %.4f (%d rows)",
+                    epoch + 1, epochs, epoch_loss, n,
                 )
         self._write_back(tv, ntv, ov)
         return history

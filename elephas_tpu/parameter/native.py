@@ -80,9 +80,11 @@ def _load_library():
         ]
         lib.eps_server_port.restype = ctypes.c_int
         lib.eps_server_port.argtypes = [ctypes.c_void_p]
+        lib.eps_server_set.restype = ctypes.c_int
         lib.eps_server_set.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
         ]
+        lib.eps_server_get.restype = ctypes.c_int
         lib.eps_server_get.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
         ]
@@ -149,19 +151,29 @@ class NativeParameterServer:
 
     def set_weights(self, weights) -> None:
         flat = np.ascontiguousarray(self._flat.flatten(weights))
-        self._lib.eps_server_set(
+        rc = self._lib.eps_server_set(
             self._handle,
             flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             flat.size,
         )
+        if rc != 0:
+            raise ValueError(
+                f"set_weights size mismatch: got {flat.size} floats, "
+                f"server stores {self._flat.total}"
+            )
 
     def get_parameters(self):
         flat = np.empty(self._flat.total, np.float32)
-        self._lib.eps_server_get(
+        rc = self._lib.eps_server_get(
             self._handle,
             flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             flat.size,
         )
+        if rc != 0:
+            raise ValueError(
+                f"get_parameters size mismatch: requested {flat.size} "
+                f"floats, server stores {self._flat.total}"
+            )
         return self._flat.unflatten(flat)
 
     def update_parameters(self, delta) -> None:

@@ -188,16 +188,23 @@ int eps_server_port(void* handle) {
   return static_cast<Server*>(handle)->port;
 }
 
-void eps_server_set(void* handle, const float* data, uint64_t n) {
+// Both return 0 on success, -1 on size mismatch: a caller-side flattener
+// built from differently-shaped weights must be an error, not a silent
+// out-of-bounds memcpy (the wire path already validates nbytes).
+int eps_server_set(void* handle, const float* data, uint64_t n) {
   auto* s = static_cast<Server*>(handle);
+  if (n != s->weights.size()) return -1;
   std::lock_guard<std::mutex> g(s->mu);
   std::memcpy(s->weights.data(), data, n * sizeof(float));
+  return 0;
 }
 
-void eps_server_get(void* handle, float* out, uint64_t n) {
+int eps_server_get(void* handle, float* out, uint64_t n) {
   auto* s = static_cast<Server*>(handle);
+  if (n != s->weights.size()) return -1;
   std::lock_guard<std::mutex> g(s->mu);
   std::memcpy(out, s->weights.data(), n * sizeof(float));
+  return 0;
 }
 
 void eps_server_stop(void* handle) {

@@ -99,3 +99,18 @@ def test_ep_composes_with_jit():
         np.asarray(_run_ep(x, params, mesh, e_local)),
         atol=1e-6,
     )
+
+
+def test_routing_exact_in_bfloat16():
+    """Regression (ADVICE r1): routing math must run in int32 — a bf16
+    cumsum goes inexact past 256 tokens, colliding queue slots."""
+    from elephas_tpu.ops.moe import _top1_dispatch
+
+    t, d, e = 512, 8, 4
+    x = jnp.ones((t, d), jnp.bfloat16)
+    gate_w = jnp.zeros((d, e), jnp.bfloat16).at[:, 0].set(1.0)
+    dispatch, combine = _top1_dispatch(x, gate_w, e, capacity=t)
+    disp = np.asarray(dispatch, dtype=np.float32)
+    # every token kept, each in a distinct queue position of expert 0
+    assert disp.sum() == t
+    assert disp[:, 0, :].sum(axis=0).max() == 1.0

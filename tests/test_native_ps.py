@@ -256,3 +256,18 @@ def test_native_client_parses_master_port(blobs):
         assert len(results) == 1
     finally:
         server.stop()
+
+
+def test_size_mismatch_raises():
+    """Regression (ADVICE r1): a flattener/store size mismatch must be a
+    loud error, not a silent out-of-bounds memcpy."""
+    import pytest
+
+    from elephas_tpu.parameter.native import NativeParameterServer
+
+    server = NativeParameterServer([np.zeros((4, 4), np.float32)])
+    try:
+        with pytest.raises(ValueError, match="size mismatch"):
+            server.set_weights([np.zeros((8, 8), np.float32)])
+    finally:
+        server.stop()

@@ -124,3 +124,32 @@ def test_predict_tiny_input(blobs):
     trainer = ShardedTrainer(model, model_parallel=2)  # dp = 4
     preds = trainer.predict(x[:1])
     assert preds.shape == (1, k)
+
+
+def test_tail_rows_train_and_match_single_device(blobs):
+    """Regression (ADVICE r1): non-tiling row counts must not drop tail
+    rows, and the masked-pad math must equal the unsharded math."""
+    x, y, d, k = blobs
+    x, y = x[:250], y[:250]  # 250 = 3*64 + 58: forces a padded tail batch
+
+    m1 = _mlp(d, k, hidden=32, seed=11)
+    t1 = ShardedTrainer(m1, mesh=dp_tp_mesh(model_parallel=1, data_parallel=1))
+    h1 = t1.fit(x, y, epochs=2, batch_size=64)
+
+    m2 = _mlp(d, k, hidden=32, seed=11)
+    t2 = ShardedTrainer(m2, model_parallel=4)
+    h2 = t2.fit(x, y, epochs=2, batch_size=64)
+
+    np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=1e-4)
+    for a, b in zip(m1.get_weights(), m2.get_weights()):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_fit_fewer_rows_than_batch(blobs):
+    """Regression (ADVICE r1): len(x) < batch_size must train (padded),
+    not crash with a sharding error."""
+    x, y, d, k = blobs
+    model = _mlp(d, k, hidden=32, seed=12)
+    trainer = ShardedTrainer(model, model_parallel=2)  # dp = 4
+    history = trainer.fit(x[:10], y[:10], epochs=2, batch_size=64)
+    assert np.isfinite(history["loss"]).all()
