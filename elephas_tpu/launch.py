@@ -1,0 +1,146 @@
+"""Process launcher — the ``spark-submit`` analogue for multi-host runs.
+
+Reference equivalent (SURVEY.md §2b): Spark's driver↔executor dispatch.
+There, a cluster manager starts executors and ships closures; here, one
+Python process per host joins a JAX coordination-service gang
+(:mod:`elephas_tpu.parallel.distributed`) and then runs the SAME user
+script everywhere — SPMD at the process level, matching how TPU pods are
+actually operated.
+
+Two ways to use it:
+
+1. Real cluster: start the same script on every host yourself (or via
+   your scheduler) with ``ELEPHAS_COORDINATOR=host0:port``,
+   ``ELEPHAS_NUM_PROCESSES=N``, ``ELEPHAS_PROCESS_ID=i`` exported, and
+   call ``elephas_tpu.parallel.distributed.initialize()`` first thing.
+   On Cloud TPU pods the env is auto-detected and none of this is needed.
+
+2. Single machine (testing / CI): ``python -m elephas_tpu.launch
+   --num-processes 2 --cpu-devices-per-process 4 script.py`` spawns the
+   gang locally with a virtual CPU mesh per process — the multi-host
+   analogue of the reference's Spark ``local[N]`` trick (SURVEY.md §4).
+
+The launcher streams each child's output (prefixed) and exits non-zero
+if any child fails — same contract as ``spark-submit``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def child_env(
+    process_id: int,
+    num_processes: int,
+    coordinator: str,
+    cpu_devices_per_process: int = 0,
+) -> dict:
+    """Environment for one gang member (exported keys are the public
+    launcher contract; see module docstring)."""
+    env = dict(os.environ)
+    env["ELEPHAS_COORDINATOR"] = coordinator
+    env["ELEPHAS_NUM_PROCESSES"] = str(num_processes)
+    env["ELEPHAS_PROCESS_ID"] = str(process_id)
+    if cpu_devices_per_process:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""  # keep TPU plugins out of CPU sim
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{cpu_devices_per_process}"
+        ).strip()
+    return env
+
+
+def launch(
+    script: str,
+    script_args: list[str] | None = None,
+    num_processes: int = 2,
+    coordinator: str | None = None,
+    cpu_devices_per_process: int = 0,
+    timeout: float | None = None,
+) -> int:
+    """Spawn the gang; stream prefixed output; return max child exit code."""
+    coordinator = coordinator or f"127.0.0.1:{free_port()}"
+    procs = []
+    for i in range(num_processes):
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, script, *(script_args or [])],
+                env=child_env(
+                    i, num_processes, coordinator, cpu_devices_per_process
+                ),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+
+    def stream(i: int, p: subprocess.Popen) -> None:
+        for line in p.stdout:
+            sys.stdout.write(f"[proc {i}] {line}")
+            sys.stdout.flush()
+
+    threads = [
+        threading.Thread(target=stream, args=(i, p), daemon=True)
+        for i, p in enumerate(procs)
+    ]
+    for t in threads:
+        t.start()
+    import time
+
+    deadline = time.monotonic() + timeout if timeout else None
+    rcs = []
+    try:
+        for p in procs:
+            remaining = (deadline - time.monotonic()) if deadline else None
+            rcs.append(p.wait(timeout=remaining))
+    except subprocess.TimeoutExpired:
+        sys.stdout.write("[launch] gang timed out; killing children\n")
+        rcs.append(124)  # timeout exit code, not an escaping exception
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for t in threads:
+        t.join(timeout=5)
+    return max(rcs) if rcs else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m elephas_tpu.launch", description=__doc__
+    )
+    p.add_argument("--num-processes", type=int, default=2)
+    p.add_argument("--coordinator", default=None, help="host:port of process 0")
+    p.add_argument(
+        "--cpu-devices-per-process",
+        type=int,
+        default=0,
+        help="simulate with N virtual CPU devices per process (testing)",
+    )
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    return launch(
+        args.script,
+        args.script_args,
+        num_processes=args.num_processes,
+        coordinator=args.coordinator,
+        cpu_devices_per_process=args.cpu_devices_per_process,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -236,6 +236,14 @@ class SparkModel:
                         )
 
                 callbacks.append(save_ckpt)
+            val_history: dict[str, list[float]] = {}
+            if val_partitions is not None:
+                # per-epoch validation, like keras.fit's val_* history
+                def eval_cb(_epoch, _loss):
+                    for k, v in runner.evaluate(val_partitions, batch_size).items():
+                        val_history.setdefault(f"val_{k}", []).append(v)
+
+                callbacks.append(eval_cb)
 
             if profile_dir:
                 import jax
@@ -257,10 +265,7 @@ class SparkModel:
                     start_epoch + epochs,
                     history,
                 )
-            if val_partitions is not None:
-                val_results = runner.evaluate(val_partitions, batch_size)
-                for k, v in val_results.items():
-                    history.setdefault(f"val_{k}", []).append(v)
+            history.update(val_history)
             self._publish_weights()
         finally:
             self.stop_server()
@@ -293,12 +298,29 @@ class SparkModel:
         if isinstance(x_test, Rdd):
             partitions = rdd_utils.partition_arrays(x_test)
         else:
+            import jax
+
             x = np.asarray(x_test)
-            y = np.asarray(y_test)
             xs = np.array_split(x, self.num_workers)
-            ys = np.array_split(y, self.num_workers)
-            partitions = [(a, b) for a, b in zip(xs, ys) if len(a)]
+            offsets = np.cumsum([0] + [len(a) for a in xs])
+            # y may be a list/tuple of per-output targets (multi-output
+            # models); split each component with the same row boundaries
+            partitions = [
+                (
+                    a,
+                    jax.tree.map(
+                        lambda t, lo=int(offsets[i]), hi=int(offsets[i + 1]): (
+                            np.asarray(t)[lo:hi]
+                        ),
+                        y_test,
+                    ),
+                )
+                for i, a in enumerate(xs)
+                if len(a)
+            ]
         results = runner.evaluate(partitions, batch_size)
+        # insertion order is the keras reporting order: loss, per-output
+        # losses, metrics in compile order
         ordered = [results.pop("loss")] + list(results.values())
         return ordered if len(ordered) > 1 else ordered[0]
 

@@ -131,6 +131,7 @@ class MeshRunner:
         self._epoch_fn = None
         self._eval_fn = None
         self._predict_fn = None
+        self._gather_fn = None
         model.optimizer.build(model.trainable_variables)
 
     # -- state plumbing ------------------------------------------------
@@ -141,16 +142,36 @@ class MeshRunner:
         ov = [np.asarray(v.value) for v in self.model.optimizer.variables]
         return tv, ntv, ov
 
+    def _local_worker_indices(self) -> list[int]:
+        """Mesh positions whose device belongs to this process (multi-host:
+        the workers whose data/state this process stages)."""
+        pid = jax.process_index()
+        return [
+            i
+            for i, d in enumerate(self.mesh.devices.flat)
+            if d.process_index == pid
+        ]
+
     def _device_state(self, stacked: bool = True):
-        """Current model state, replicated to ``[W, ...]`` worker shards."""
+        """Current model state, replicated to ``[W, ...]`` worker shards.
+
+        Multi-host: each process materializes only its addressable
+        workers' slices (``jax.make_array_from_process_local_data``); the
+        global array spans the pod without any host holding all of it.
+        """
         W = self.num_workers
         sharding = NamedSharding(self.mesh, P("workers"))
         tv, ntv, ov = self._host_state()
+        multiproc = jax.process_count() > 1
+        n_local = len(self._local_worker_indices()) if multiproc else W
 
         def rep(leaf):
-            return jax.device_put(
-                np.broadcast_to(leaf[None], (W,) + leaf.shape), sharding
-            )
+            local = np.broadcast_to(leaf[None], (n_local,) + leaf.shape)
+            if multiproc:
+                return jax.make_array_from_process_local_data(
+                    sharding, local, (W,) + leaf.shape
+                )
+            return jax.device_put(local, sharding)
 
         return (
             [rep(l) for l in tv],
@@ -159,29 +180,51 @@ class MeshRunner:
         )
 
     def _shard_data(self, arr: np.ndarray):
-        return jax.device_put(arr, NamedSharding(self.mesh, P("workers")))
+        sharding = NamedSharding(self.mesh, P("workers"))
+        if jax.process_count() > 1:
+            local = arr[np.asarray(self._local_worker_indices())]
+            return jax.make_array_from_process_local_data(
+                sharding, local, arr.shape
+            )
+        return jax.device_put(arr, sharding)
+
+    @staticmethod
+    def _worker_slice(leaf, index: int = 0):
+        """One worker's slice of a ``[W, ...]``-sharded leaf. Multi-host,
+        leaves span non-addressable devices — read the first local shard
+        instead (all replicas agree post-sync)."""
+        if getattr(leaf, "is_fully_addressable", True):
+            return np.asarray(leaf[index])
+        return np.asarray(leaf.addressable_shards[0].data)[0]
 
     def _write_back(self, tv, ntv, ov=None):
         """Worker-0 slice → model variables (all replicas agree post-sync)."""
         for var, leaf in zip(self.model.trainable_variables, tv):
-            var.assign(np.asarray(leaf[0]))
+            var.assign(self._worker_slice(leaf))
         for var, leaf in zip(self.model.non_trainable_variables, ntv):
-            var.assign(np.asarray(leaf[0]))
+            var.assign(self._worker_slice(leaf))
         if ov is not None:
             for var, leaf in zip(self.model.optimizer.variables, ov):
-                var.assign(np.asarray(leaf[0]))
+                var.assign(self._worker_slice(leaf))
 
     # -- loss helpers --------------------------------------------------
 
     def _loss_and_updates(self, tv, ntv, x, y):
         y_pred, ntv2 = self.model.stateless_call(tv, ntv, x, training=True)
         loss = self.model.compute_loss(x=x, y=y, y_pred=y_pred)
-        return loss, ntv2
+        return loss, (ntv2, y_pred)
 
-    def _per_sample_loss_fn(self):
+    def _output_names(self) -> list[str]:
+        names = list(getattr(self.model, "output_names", []) or [])
+        if not names:
+            n_out = len(getattr(self.model, "outputs", None) or [1])
+            names = [f"output_{i}" for i in range(n_out)]
+        return names
+
+    def _single_loss_fn(self, loss):
+        """One loss spec → per-sample (unreduced) callable."""
         import keras
 
-        loss = self.model.loss
         if isinstance(loss, str):
             fn = keras.losses.get(loss)  # plain function: per-sample values
         elif isinstance(loss, keras.losses.Loss):
@@ -189,10 +232,7 @@ class MeshRunner:
         elif callable(loss):
             fn = loss
         else:
-            raise ValueError(
-                f"unsupported loss spec {loss!r} (multi-output losses not yet "
-                "supported by the distributed evaluator)"
-            )
+            raise ValueError(f"unsupported loss spec {loss!r}")
 
         def aligned(y, y_pred):
             # keras Loss.__call__ squeezes/expands rank-mismatched targets
@@ -204,50 +244,132 @@ class MeshRunner:
 
         return aligned
 
+    def _per_sample_loss_fn(self):
+        """Per-sample loss over possibly multi-output models.
+
+        Returns ``fn(y, y_pred) -> dict`` with key ``'loss'`` ([B] total,
+        loss-weighted like ``keras.Model.compute_loss``) plus
+        ``'<output>_loss'`` per output when the model has several
+        (matching ``keras.Model.evaluate``'s reporting).
+        """
+        loss = self.model.loss
+        names = self._output_names()
+        weights = getattr(
+            getattr(self.model, "_compile_loss", None), "_user_loss_weights", None
+        )
+        # weight-by-output-name first, then select: keeps list weights
+        # aligned to outputs even when a dict loss omits some of them
+        if isinstance(weights, dict):
+            weight_of = {n: float(weights.get(n, 1.0)) for n in names}
+        elif weights is not None:
+            weight_of = {n: float(w) for n, w in zip(names, weights)}
+        else:
+            weight_of = {n: 1.0 for n in names}
+
+        if isinstance(loss, (list, tuple)):
+            specs = list(loss)
+        elif isinstance(loss, dict):
+            missing = [n for n in loss if n not in names]
+            if missing:
+                raise ValueError(
+                    f"loss dict keys {missing} do not match outputs {names}"
+                )
+            specs = [loss[n] for n in names if n in loss]
+            names = [n for n in names if n in loss]
+        else:
+            fn = self._single_loss_fn(loss)
+            return lambda y, y_pred: {"loss": fn(y, y_pred)}
+
+        fns = [self._single_loss_fn(s) for s in specs]
+        ws = [weight_of[n] for n in names]
+
+        def multi(y, y_pred):
+            ys = list(y) if isinstance(y, (list, tuple)) else [y]
+            yps = list(y_pred) if isinstance(y_pred, (list, tuple)) else [y_pred]
+            out = {}
+            total = 0.0
+            for name, f, w, yi, ypi in zip(names, fns, ws, ys, yps):
+                values = f(yi, ypi)
+                out[f"{name}_loss"] = values
+                total = total + w * values
+            out["loss"] = total
+            return out
+
+        return multi
+
     def _unwrapped_metrics(self, x_sample, y_sample):
-        """Compiled metric objects, built and with CompileMetrics expanded.
+        """Compiled metric entries: ``(metric, output_index, reported_name)``.
 
         CompileMetrics mishandles ``sample_weight`` in its count update
         (observed keras 3.13), so the underlying metrics are used directly
-        for exact padded-batch aggregation. CompileMetrics (and its inner
-        metrics) build lazily — force variable creation with one tiny
-        host-side update, then reset.
+        for exact padded-batch aggregation. For multi-output models the
+        per-output nesting (``CompileMetrics._flat_metrics``) supplies the
+        output index and the ``<output>_<metric>`` reported name keras
+        uses. CompileMetrics (and its inner metrics) build lazily — force
+        variable creation with one tiny host-side update, then reset.
         """
-        yp = np.asarray(self.model(x_sample[:1], training=False))
+        yp = self.model(x_sample[:1], training=False)
+        multi = isinstance(yp, (list, tuple))
+        names = self._output_names()
+
+        def y_head(y):
+            return jax.tree.map(lambda a: np.asarray(a)[:1], y)
+
+        # loss trackers ('loss' plus per-output '<name>_loss' Means) are
+        # computed by the evaluator's own per-sample loss path, not as
+        # y/y_pred metrics
+        loss_tracker_names = set(self._loss_keys())
         out = []
         for m in self.model.metrics:
-            if m.name == "loss":
+            if m.name in loss_tracker_names:
                 continue
-            if not getattr(m, "metrics", None) and not m.variables:
-                m.update_state(y_sample[:1], yp)
+            is_compile = type(m).__name__ == "CompileMetrics"
+            if is_compile and not getattr(m, "metrics", None):
+                m.update_state(y_head(y_sample), yp)
                 m.reset_state()
-            inner = getattr(m, "metrics", None)
-            if inner:
-                out.extend(inner)
+            per_output = getattr(m, "_flat_metrics", None)
+            if is_compile and multi and per_output is not None:
+                for i, bucket in enumerate(per_output):
+                    for mm in getattr(bucket, "metrics", None) or []:
+                        out.append((mm, i, f"{names[i]}_{mm.name}"))
+            elif is_compile and getattr(m, "metrics", None):
+                out.extend((mm, 0, mm.name) for mm in m.metrics)
             else:
-                out.append(m)
-        for m in out:
-            if not m.variables:
-                m.update_state(y_sample[:1], yp)
-                m.reset_state()
+                out.append((m, 0, m.name))
+        for mm, i, _name in out:
+            if not mm.variables:
+                yi = y_sample[i] if multi else y_sample
+                ypi = yp[i] if multi else yp
+                mm.update_state(np.asarray(yi)[:1], ypi)
+                mm.reset_state()
         return out
 
     # -- training ------------------------------------------------------
 
-    def _build_epoch_fn(self):
+    def _build_epoch_fn(self, metric_objects=None):
+        """One whole training epoch as a single XLA program.
+
+        With ``metric_objects`` (from :meth:`_unwrapped_metrics`), metric
+        states thread through the batch scan exactly as keras accumulates
+        training metrics over an epoch, then ``psum`` across workers
+        (Mean-type states are additive) — history gains the compiled
+        metrics with zero extra forward passes.
+        """
         mode, frequency = self.mode, self.frequency
         grad_fn = jax.value_and_grad(self._loss_and_updates, has_aux=True)
         optimizer = self.model.optimizer
+        metric_objects = metric_objects or []
 
-        def per_worker(tv, ntv, ov, xb, yb):
-            # leaves arrive as the worker's [1, ...] shard
+        def per_worker(tv, ntv, ov, mvs, xb, yb):
+            # tv/ntv/ov arrive as the worker's [1, ...] shard; mvs arrive
+            # whole (replicated zeros) and leave whole (psum'd)
             tv, ntv, ov = _unstack0(tv), _unstack0(ntv), _unstack0(ov)
             xb, yb = xb[0], yb[0]
 
             def step(carry, batch):
-                tv, ntv, ov = carry
+                tv, ntv, ov, mvs = carry
                 x, y = batch
-                (loss, ntv2), grads = grad_fn(tv, ntv, x, y)
+                (loss, (ntv2, y_pred)), grads = grad_fn(tv, ntv, x, y)
                 if mode == "synchronous" and frequency != "fit":
                     grads = jax.lax.pmean(grads, "workers")
                     ntv2 = _pmean_floats(ntv2, "workers")
@@ -255,28 +377,47 @@ class MeshRunner:
                 if mode != "synchronous" and frequency == "batch":
                     tv2 = _pmean_floats(tv2, "workers")
                     ntv2 = _pmean_floats(ntv2, "workers")
-                return (tv2, ntv2, ov2), loss
+                mvs2 = [
+                    m.stateless_update_state(mv, y, y_pred)
+                    for (m, _i, _n), mv in zip(metric_objects, mvs)
+                ]
+                return (tv2, ntv2, ov2, mvs2), loss
 
-            (tv, ntv, ov), losses = jax.lax.scan(step, (tv, ntv, ov), (xb, yb))
+            (tv, ntv, ov, mvs), losses = jax.lax.scan(
+                step, (tv, ntv, ov, mvs), (xb, yb)
+            )
             if mode != "synchronous" and frequency == "epoch":
                 tv = _pmean_floats(tv, "workers")
                 ntv = _pmean_floats(ntv, "workers")
-            loss = jnp.mean(losses)
+            # merge metric states across workers (additive for Mean-types);
+            # loss pmean'd so every process can read it without a gather
+            mvs = jax.tree.map(lambda a: jax.lax.psum(a, "workers"), mvs)
+            loss = jax.lax.pmean(jnp.mean(losses), "workers")
             return (
                 _stack0(tv),
                 _stack0(ntv),
                 _stack0(ov),
-                loss[None],
+                mvs,
+                loss,
             )
 
         sharded = shard_map(
             per_worker,
             mesh=self.mesh,
-            in_specs=(P("workers"), P("workers"), P("workers"), P("workers"), P("workers")),
-            out_specs=(P("workers"), P("workers"), P("workers"), P("workers")),
+            in_specs=(P("workers"), P("workers"), P("workers"), P(),
+                      P("workers"), P("workers")),
+            out_specs=(P("workers"), P("workers"), P("workers"), P(), P()),
             check_rep=False,
         )
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+    def _zero_metric_state(self, metric_objects):
+        """Fresh metric variables (host zeros; replicated into the
+        program via the P() in_spec — identical on every process)."""
+        return [
+            [np.zeros(v.shape, v.dtype) for v in m.variables]
+            for m, _i, _n in metric_objects
+        ]
 
     def run_epochs(
         self,
@@ -287,7 +428,14 @@ class MeshRunner:
         callbacks=None,
     ) -> dict:
         """Run ``epochs`` compiled epochs; returns a Keras-style history dict
-        and leaves trained weights on the master model."""
+        (loss + every compiled metric, like ``keras.Model.fit``) and leaves
+        trained weights on the master model.
+
+        Metric values count wrap-padded rows of ragged final batches
+        (duplicated samples weigh in twice) — the same rows the loss
+        already trains on; exact de-duplication would put masks in the
+        train program for a sub-1% reporting delta on real shards.
+        """
         if len(partitions) != self.num_workers:
             raise ValueError(
                 f"got {len(partitions)} partitions for {self.num_workers} workers"
@@ -296,14 +444,23 @@ class MeshRunner:
         xb = self._shard_data(xs)
         yb = self._shard_data(ys)
         tv, ntv, ov = self._device_state()
+        metric_objects = self._unwrapped_metrics(partitions[0][0], partitions[0][1])
         if self._epoch_fn is None:
-            self._epoch_fn = self._build_epoch_fn()
+            self._epoch_fn = self._build_epoch_fn(metric_objects)
 
         history: dict[str, list[float]] = {"loss": []}
         for epoch in range(epochs):
-            tv, ntv, ov, losses = self._epoch_fn(tv, ntv, ov, xb, yb)
-            epoch_loss = float(np.mean(np.asarray(losses)))
+            mvs = self._zero_metric_state(metric_objects)
+            tv, ntv, ov, mvs, loss = self._epoch_fn(tv, ntv, ov, mvs, xb, yb)
+            epoch_loss = float(np.asarray(loss))  # replicated: direct read
             history["loss"].append(epoch_loss)
+            for (m, _i, name), mv in zip(metric_objects, mvs):
+                res = m.stateless_result(mv)
+                if isinstance(res, dict):
+                    for k, v in res.items():
+                        history.setdefault(k, []).append(float(np.asarray(v)))
+                else:
+                    history.setdefault(name, []).append(float(np.asarray(res)))
             if verbose:
                 logger.info("epoch %d/%d - loss: %.4f", epoch + 1, epochs, epoch_loss)
             if callbacks:
@@ -315,59 +472,98 @@ class MeshRunner:
 
         # 'fit' frequency (reference-parity synchronous): average once at end.
         if self.frequency == "fit":
-            tv = [np.mean(np.asarray(l), axis=0, keepdims=True).repeat(self.num_workers, 0) for l in tv]
+            tv = [
+                np.mean(self._gather(l), axis=0, keepdims=True).repeat(
+                    self.num_workers, 0
+                )
+                for l in tv
+            ]
             ntv = [
-                np.mean(np.asarray(l), axis=0, keepdims=True).repeat(self.num_workers, 0)
-                if np.issubdtype(np.asarray(l).dtype, np.floating)
-                else np.asarray(l)
+                np.mean(self._gather(l), axis=0, keepdims=True).repeat(
+                    self.num_workers, 0
+                )
+                if np.issubdtype(l.dtype, np.floating)
+                else self._gather(l)
                 for l in ntv
             ]
         self._write_back(tv, ntv, ov)
         return history
 
+    def _gather(self, leaf) -> np.ndarray:
+        """Full ``[W, ...]`` host value of a worker-sharded leaf; when the
+        leaf spans other processes, replicate via an identity jit (XLA
+        all-gather) so every process can read it."""
+        if getattr(leaf, "is_fully_addressable", True):
+            return np.asarray(leaf)
+        if self._gather_fn is None:
+            self._gather_fn = jax.jit(
+                lambda a: a, out_shardings=NamedSharding(self.mesh, P())
+            )
+        return np.asarray(self._gather_fn(leaf))
+
     # -- evaluation ----------------------------------------------------
 
-    def _build_eval_fn(self, metric_objects):
+    def _build_eval_fn(self, metric_objects, loss_keys):
         per_sample_loss = self._per_sample_loss_fn()
 
         def per_worker(tv, ntv, mvs, xb, yb, wb):
+            # tv/ntv arrive as [1, ...] worker shards; mvs arrive whole
+            # (replicated zeros) and leave whole (psum'd across workers)
             tv, ntv = _unstack0(tv), _unstack0(ntv)
-            mvs = _unstack0(mvs)
-            xb, yb, wb = xb[0], yb[0], wb[0]
+            xb = xb[0]
+            yb = jax.tree.map(lambda a: a[0], yb)
+            wb = wb[0]
             model = self.model
+            multi = len(self._output_names()) > 1
 
             def step(carry, batch):
-                loss_sum, weight_sum, mvs = carry
+                loss_sums, weight_sum, mvs = carry
                 x, y, w = batch
                 y_pred, _ = model.stateless_call(tv, ntv, x, training=False)
                 values = per_sample_loss(y, y_pred)
-                loss_sum = loss_sum + jnp.sum(values * w)
+                loss_sums = {
+                    k: loss_sums[k] + jnp.sum(values[k] * w) for k in loss_keys
+                }
                 weight_sum = weight_sum + jnp.sum(w)
                 new_mvs = []
-                for m, mv in zip(metric_objects, mvs):
+                for (m, i, _name), mv in zip(metric_objects, mvs):
+                    yi = y[i] if multi else y
+                    ypi = y_pred[i] if multi else y_pred
                     new_mvs.append(
-                        m.stateless_update_state(mv, y, y_pred, sample_weight=w)
+                        m.stateless_update_state(mv, yi, ypi, sample_weight=w)
                     )
-                return (loss_sum, weight_sum, new_mvs), None
+                return (loss_sums, weight_sum, new_mvs), None
 
-            init_mvs = mvs
-            (loss_sum, weight_sum, mvs), _ = jax.lax.scan(
-                step, (jnp.float32(0), jnp.float32(0), init_mvs), (xb, yb, wb)
+            zeros = {k: jnp.float32(0) for k in loss_keys}
+            (loss_sums, weight_sum, mvs), _ = jax.lax.scan(
+                step, (zeros, jnp.float32(0), mvs), (xb, yb, wb)
             )
-            # additive merge across workers (Mean-type metric states sum)
-            loss_sum = jax.lax.psum(loss_sum, "workers")
+            # additive merge across workers (Mean-type metric states sum);
+            # everything leaves replicated so any process reads it directly
+            loss_sums = jax.tree.map(lambda a: jax.lax.psum(a, "workers"), loss_sums)
             weight_sum = jax.lax.psum(weight_sum, "workers")
             mvs = jax.tree.map(lambda a: jax.lax.psum(a, "workers"), mvs)
-            return loss_sum[None], weight_sum[None], _stack0(mvs)
+            return loss_sums, weight_sum, mvs
 
         sharded = shard_map(
             per_worker,
             mesh=self.mesh,
-            in_specs=(P("workers"),) * 6,
-            out_specs=(P("workers"), P("workers"), P("workers")),
+            in_specs=(P("workers"), P("workers"), P(), P("workers"),
+                      P("workers"), P("workers")),
+            out_specs=(P(), P(), P()),
             check_rep=False,
         )
         return jax.jit(sharded)
+
+    def _loss_keys(self) -> list[str]:
+        """Reported loss keys, in keras order: total first, then per-output."""
+        loss = self.model.loss
+        names = self._output_names()
+        if isinstance(loss, dict):
+            return ["loss"] + [f"{n}_loss" for n in names if n in loss]
+        if isinstance(loss, (list, tuple)):
+            return ["loss"] + [f"{n}_loss" for n in names]
+        return ["loss"]
 
     def evaluate(
         self,
@@ -377,6 +573,10 @@ class MeshRunner:
         """Distributed evaluate → ``{'loss': ..., <metric>: ...}``.
 
         Padding rows carry zero sample-weight, so aggregates are exact.
+        Multi-output models (``y`` a list/tuple per partition, list/dict
+        compiled losses) report keras-style ``<output>_loss`` and
+        ``<output>_<metric>`` keys; dict insertion order is the keras
+        reporting order (loss, per-output losses, metrics).
         """
         partitions = self._fit_partitions_to_mesh(partitions)
         counts = [len(x) for x, _ in partitions]
@@ -388,39 +588,38 @@ class MeshRunner:
             idx = np.arange(total) % n
             w = (np.arange(total) < n).astype(np.float32)
             xs.append(x[idx].reshape((nb, batch_size) + x.shape[1:]))
-            ys.append(y[idx].reshape((nb, batch_size) + y.shape[1:]))
+            ys.append(
+                jax.tree.map(
+                    lambda a: np.asarray(a)[idx].reshape(
+                        (nb, batch_size) + np.asarray(a).shape[1:]
+                    ),
+                    y,
+                )
+            )
             ws.append(w.reshape((nb, batch_size)))
         xb = self._shard_data(np.stack(xs))
-        yb = self._shard_data(np.stack(ys))
+        yb = jax.tree.map(lambda *parts: self._shard_data(np.stack(parts)), *ys)
         wb = self._shard_data(np.stack(ws))
 
         metric_objects = self._unwrapped_metrics(partitions[0][0], partitions[0][1])
-        mvs = []
-        W = self.num_workers
-        sharding = NamedSharding(self.mesh, P("workers"))
-        for m in metric_objects:
-            zeros = [np.zeros(v.shape, v.dtype) for v in m.variables]
-            mvs.append(
-                [
-                    jax.device_put(np.broadcast_to(z[None], (W,) + z.shape), sharding)
-                    for z in zeros
-                ]
-            )
+        loss_keys = self._loss_keys()
+        mvs = self._zero_metric_state(metric_objects)
         tv, ntv, _ = self._device_state()
 
         if self._eval_fn is None:
-            self._eval_fn = self._build_eval_fn(metric_objects)
-        loss_sum, weight_sum, mvs = self._eval_fn(tv, ntv, mvs, xb, yb, wb)
+            self._eval_fn = self._build_eval_fn(metric_objects, loss_keys)
+        loss_sums, weight_sum, mvs = self._eval_fn(tv, ntv, mvs, xb, yb, wb)
+        denom = float(np.asarray(weight_sum))  # replicated scalars: direct read
         results = {
-            "loss": float(np.asarray(loss_sum)[0] / np.asarray(weight_sum)[0])
+            k: float(np.asarray(loss_sums[k])) / denom for k in loss_keys
         }
-        for m, mv in zip(metric_objects, mvs):
-            res = m.stateless_result(_unstack0(mv))
+        for (m, _i, name), mv in zip(metric_objects, mvs):
+            res = m.stateless_result(mv)
             if isinstance(res, dict):
                 for k, v in res.items():
                     results[k] = float(np.asarray(v))
             else:
-                results[m.name] = float(np.asarray(res))
+                results[name] = float(np.asarray(res))
         return results
 
     # -- prediction ----------------------------------------------------
@@ -482,18 +681,29 @@ class MeshRunner:
         return [a for a in np.array_split(arrs, n) if len(a)]
 
     def _fit_partitions_to_mesh(self, partitions):
-        """Coalesce/split (x, y) partitions to exactly ``num_workers``."""
+        """Coalesce/split (x, y) partitions to exactly ``num_workers``.
+
+        ``y`` may be any pytree of row-aligned arrays (multi-output
+        models evaluate with tuple/list targets).
+        """
         if len(partitions) == self.num_workers:
             return partitions
         x = np.concatenate([p[0] for p in partitions])
-        y = np.concatenate([p[1] for p in partitions])
+        y = jax.tree.map(
+            lambda *ps: np.concatenate([np.asarray(a) for a in ps]),
+            *[p[1] for p in partitions],
+        )
         xs = np.array_split(x, self.num_workers)
-        ys = np.array_split(y, self.num_workers)
+        offsets = np.cumsum([0] + [len(a) for a in xs])
         out = []
-        for a, b in zip(xs, ys):
+        for i, a in enumerate(xs):
+            lo, hi = int(offsets[i]), int(offsets[i + 1])
             if len(a) == 0:
                 # re-use a sample from the first shard; zero-weighted later
-                a, b = xs[0][:1], ys[0][:1]
+                a = xs[0][:1]
+                b = jax.tree.map(lambda t: t[:1], y)
+            else:
+                b = jax.tree.map(lambda t: t[lo:hi], y)
             out.append((a, b))
         return out
 
