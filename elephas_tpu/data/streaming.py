@@ -1,0 +1,118 @@
+"""Out-of-core sharded input streaming.
+
+The reference's RDDs are cluster-resident: no single host ever holds the
+dataset, and executors pull their partitions from Spark's block manager
+(``[U] elephas/utils/rdd_utils.py`` — "the layer the north star keys on",
+SURVEY.md §2). The round-1 build staged whole epochs into device memory,
+capping dataset size at HBM capacity. This module removes that cap the
+TPU way:
+
+- the dataset stays in its backing store (``np.ndarray``, ``np.memmap``,
+  ``h5py.Dataset`` — anything sliceable by a row-index array);
+- each worker owns a contiguous row range (the partition→worker mapping);
+- epochs stream as **blocks** of ``block_steps`` batches per worker,
+  gathered chunk-by-chunk on the host and staged onto the mesh while the
+  previous block's compiled program is still running (JAX async dispatch
+  gives the overlap for free: the block call returns before the devices
+  finish, so the next host-side gather and ``device_put`` run under the
+  current block's compute);
+- the SAME compiled epoch program processes a block (shape
+  ``[W, block_steps, B, ...]``), so streamed training is bit-identical to
+  staged training over the same row order.
+
+Short final blocks wrap-pad rows exactly like the staged path
+(:func:`elephas_tpu.worker.pad_to_batches` semantics).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator
+
+import numpy as np
+
+
+class ShardedStream:
+    """Blockwise iterator over a worker-sharded dataset.
+
+    ``x``/``y`` are row-aligned sliceable sources. Worker ``w`` owns rows
+    ``[w·per_w, (w+1)·per_w)`` (the last shard may be short and wraps
+    within itself, matching ``stack_worker_batches``). ``steps_per_epoch``
+    truncates the epoch (reference ``fit`` has no such knob because Spark
+    partitions are the unit; streaming needs one).
+    """
+
+    def __init__(
+        self,
+        x,
+        y,
+        batch_size: int,
+        num_workers: int,
+        block_steps: int = 16,
+        steps_per_epoch: int | None = None,
+    ):
+        if len(x) != len(y):
+            raise ValueError(f"x/y row mismatch: {len(x)} vs {len(y)}")
+        if len(x) == 0:
+            raise ValueError("cannot stream an empty dataset")
+        self.x, self.y = x, y
+        self.batch_size = batch_size
+        self.num_workers = num_workers
+        self.block_steps = max(1, block_steps)
+        n = len(x)
+        per_w = math.ceil(n / num_workers)
+        self.starts = [min(w * per_w, n - 1) for w in range(num_workers)]
+        self.counts = [
+            max(1, min((w + 1) * per_w, n) - w * per_w) for w in range(num_workers)
+        ]
+        full_steps = math.ceil(max(self.counts) / batch_size)
+        self.steps = (
+            min(full_steps, steps_per_epoch) if steps_per_epoch else full_steps
+        )
+
+    @property
+    def num_blocks(self) -> int:
+        return math.ceil(self.steps / self.block_steps)
+
+    def _gather_rows(self, source, w: int, step_lo: int, step_hi: int):
+        """Rows for worker ``w``, steps ``[step_lo, step_hi)``, wrap-padded
+        within the worker's own range — only this chunk materializes."""
+        count = self.counts[w]
+        start = self.starts[w]
+        lo = step_lo * self.batch_size
+        hi = step_hi * self.batch_size
+        idx = start + (np.arange(lo, hi) % count)
+        rows = np.asarray(source[idx])
+        return rows.reshape(
+            (step_hi - step_lo, self.batch_size) + rows.shape[1:]
+        )
+
+    def blocks(self) -> Iterator[tuple[np.ndarray, np.ndarray, int]]:
+        """Yields ``(x_block [W, s, B, ...], y_block, steps_in_block)``."""
+        for b in range(self.num_blocks):
+            lo = b * self.block_steps
+            hi = min(self.steps, lo + self.block_steps)
+            xb = np.stack(
+                [self._gather_rows(self.x, w, lo, hi) for w in range(self.num_workers)]
+            )
+            yb = np.stack(
+                [self._gather_rows(self.y, w, lo, hi) for w in range(self.num_workers)]
+            )
+            yield xb, yb, hi - lo
+
+    def nbytes_per_block(self) -> int:
+        row = (
+            np.asarray(self.x[0:1]).nbytes + np.asarray(self.y[0:1]).nbytes
+        )
+        return row * self.batch_size * self.block_steps * self.num_workers
+
+
+def estimate_nbytes(x, y) -> int:
+    """Dataset size estimate without materializing lazy sources."""
+    nb = getattr(x, "nbytes", None)
+    if nb is None:
+        nb = np.asarray(x[0:1]).nbytes * len(x)
+    nb_y = getattr(y, "nbytes", None)
+    if nb_y is None:
+        nb_y = np.asarray(y[0:1]).nbytes * len(y)
+    return int(nb) + int(nb_y)

@@ -82,6 +82,177 @@ def sample_space(space: dict, rng: np.random.Generator) -> dict:
     }
 
 
+# -- adaptive (TPE-style) sampling -------------------------------------
+
+
+class TpeSampler:
+    """Factorized Tree-of-Parzen-Estimators sampler (hyperopt's
+    ``tpe.suggest`` shape, reimplemented small: ``[U]
+    elephas/hyperparam.py`` delegates to hyperopt; this framework carries
+    the strategy natively).
+
+    Completed trials split into good (best ``gamma`` quantile) and bad;
+    numeric dimensions draw candidates from a Parzen mixture over the
+    good values (log-transformed for ``loguniform``) and keep the
+    candidate maximizing ``density_good / density_bad``; ``choice``
+    dimensions sample from add-one-smoothed good counts. Falls back to
+    random sampling until ``min_observations`` trials complete.
+    """
+
+    def __init__(
+        self,
+        space: dict,
+        seed: int | None = None,
+        gamma: float = 0.25,
+        n_candidates: int = 24,
+        min_observations: int = 4,
+    ):
+        self.space = space
+        self.keys = sorted(space)
+        self.rng = np.random.default_rng(seed)
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.min_observations = min_observations
+
+    # numeric transform: TPE operates in the distribution's natural space
+    def _transform(self, dist: _Dist, value):
+        return float(np.log(value)) if dist.kind == "loguniform" else float(value)
+
+    def _untransform(self, dist: _Dist, value: float):
+        if dist.kind == "loguniform":
+            return float(np.exp(value))
+        if dist.kind == "quniform":
+            lo, hi, q = dist.args
+            value = round(np.clip(value, lo, hi) / q) * q
+            return int(value) if float(q).is_integer() else float(value)
+        lo, hi = dist.args
+        return float(np.clip(value, lo, hi))
+
+    @staticmethod
+    def _parzen_logdensity(x, points: np.ndarray, bw: np.ndarray) -> float:
+        """log of a normalized Gaussian-mixture density with per-kernel
+        bandwidths (hyperopt's adaptive Parzen estimator shape)."""
+        z = (x - points) / bw
+        logk = -0.5 * z * z - np.log(bw)
+        m = np.max(logk)
+        return float(m + np.log(np.mean(np.exp(logk - m))))
+
+    @staticmethod
+    def _adaptive_bw(points: np.ndarray, lo: float, hi: float) -> np.ndarray:
+        """Per-point bandwidth = distance to the farther neighbor (range
+        bounds count as neighbors), clipped. Edge points get wide kernels,
+        so candidate draws keep probing beyond the incumbent cluster —
+        the piece that prevents premature collapse."""
+        span = max(hi - lo, 1e-9)
+        order = np.argsort(points)
+        srt = points[order]
+        left = np.diff(srt, prepend=lo)
+        right = np.diff(srt, append=hi)
+        bw_sorted = np.clip(
+            np.maximum(left, right), span / min(100.0, 1.0 + 10 * len(srt)), span
+        )
+        bw = np.empty_like(bw_sorted)
+        bw[order] = bw_sorted
+        return bw
+
+    def _bounds(self, dist: _Dist) -> tuple[float, float]:
+        lo, hi = dist.args[0], dist.args[1]
+        if dist.kind == "loguniform":
+            return float(np.log(lo)), float(np.log(hi))
+        return float(lo), float(hi)
+
+    def _sample_numeric(self, dist: _Dist, good: np.ndarray, bad: np.ndarray):
+        lo, hi = self._bounds(dist)
+        bw_good = self._adaptive_bw(good, lo, hi)
+        bw_bad = self._adaptive_bw(bad, lo, hi)
+        # candidates drawn from the good mixture plus a uniform prior
+        # slice; winner maximizes the expected-improvement surrogate
+        # density_good / density_bad (hyperopt's selection rule)
+        n_prior = max(1, self.n_candidates // 4)
+        pick = self.rng.integers(len(good), size=self.n_candidates - n_prior)
+        candidates = np.concatenate(
+            [
+                good[pick] + self.rng.normal(size=len(pick)) * bw_good[pick],
+                self.rng.uniform(lo, hi, size=n_prior),
+            ]
+        )
+        candidates = np.clip(candidates, lo, hi)
+        scores = [
+            self._parzen_logdensity(c, good, bw_good)
+            - self._parzen_logdensity(c, bad, bw_bad)
+            for c in candidates
+        ]
+        return float(candidates[int(np.argmax(scores))])
+
+    def sample_batch(self, n: int, history: list[tuple[dict, float]]) -> list[dict]:
+        """``n`` new parameter dicts, informed by completed ``(params,
+        loss)`` history (NaN losses count as bad)."""
+        finite = [(p, l) for p, l in history if np.isfinite(l)]
+        if len(finite) < self.min_observations:
+            return [sample_space(self.space, self.rng) for _ in range(n)]
+        order = sorted(history, key=lambda t: (not np.isfinite(t[1]), t[1]))
+        n_good = max(2, int(np.ceil(self.gamma * len(order))))
+        if len(order) - n_good < 2:
+            return [sample_space(self.space, self.rng) for _ in range(n)]
+        good, bad = order[:n_good], order[n_good:]
+
+        out = []
+        for _ in range(n):
+            params = {}
+            for key in self.keys:
+                dist = self.space[key]
+                if not isinstance(dist, _Dist):
+                    params[key] = dist
+                    continue
+                if dist.kind == "choice":
+                    options = dist.args[0]
+                    counts = np.ones(len(options))
+                    for p, _l in good:
+                        if p[key] in options:
+                            counts[options.index(p[key])] += 1
+                    params[key] = options[
+                        int(self.rng.choice(len(options), p=counts / counts.sum()))
+                    ]
+                else:
+                    gv = np.array([self._transform(dist, p[key]) for p, _l in good])
+                    bv = np.array([self._transform(dist, p[key]) for p, _l in bad])
+                    params[key] = self._untransform(
+                        dist, self._sample_numeric(dist, gv, bv)
+                    )
+            out.append(params)
+        return out
+
+
+def _encode_params(params: dict, space: dict) -> list[float]:
+    """Params → float32-safe vector (choice dims ride as option index)."""
+    vec = []
+    for key in sorted(space):
+        dist = space[key]
+        if not isinstance(dist, _Dist):  # constant: rides as placeholder
+            vec.append(np.float32(0.0))
+        elif dist.kind == "choice":
+            vec.append(np.float32(dist.args[0].index(params[key])))
+        else:
+            vec.append(np.float32(params[key]))
+    return vec
+
+
+def _decode_params(vec, space: dict) -> dict:
+    params = {}
+    for j, key in enumerate(sorted(space)):
+        dist = space[key]
+        if not isinstance(dist, _Dist):  # constant lives in the space
+            params[key] = dist
+        elif dist.kind == "choice":
+            params[key] = dist.args[0][int(vec[j])]
+        elif dist.kind == "quniform":
+            q = dist.args[2]
+            params[key] = int(vec[j]) if float(q).is_integer() else float(vec[j])
+        else:
+            params[key] = float(vec[j])
+    return params
+
+
 # -- trials ------------------------------------------------------------
 
 
@@ -93,13 +264,21 @@ class Trial:
 
 
 class HyperParamModel:
-    """Distributed random search over Keras model builders."""
+    """Distributed hyperparameter search over Keras model builders.
+
+    ``strategy='adaptive'`` (default, the hyperopt-TPE analogue) samples
+    each round informed by completed trials; ``'random'`` reproduces the
+    reference's ``rand.suggest`` behavior. Multi-host gangs split each
+    round's trials across processes and share (params, loss) results
+    through an all-gather, so the adaptive sampler sees the global
+    history.
+    """
 
     def __init__(self, sc=None, num_workers: int | None = None, seed: int | None = None):
         import jax
 
         self.sc = sc  # accepted for API parity; search needs no RDDs
-        devices = jax.devices()
+        devices = jax.local_devices()  # trials are per-process work
         self.num_workers = min(num_workers or len(devices), len(devices))
         self.devices = devices
         self.seed = seed
@@ -115,49 +294,60 @@ class HyperParamModel:
         epochs: int = 5,
         batch_size: int = 32,
         verbose: int = 0,
+        strategy: str = "adaptive",
     ):
-        """Run ``max_evals`` sampled trials; returns the best trained model.
+        """Run ``max_evals`` trials; returns the best trained model.
 
         ``model(params)`` must return a *compiled* keras model;
         ``data`` is ``(x_train, y_train, x_val, y_val)`` or a callable
         producing it. Per-trial validation loss decides the winner.
         """
+        import jax
         from jax.sharding import Mesh
 
         from elephas_tpu.worker import MeshRunner
 
+        if strategy not in ("adaptive", "random"):
+            raise ValueError(
+                f"strategy must be 'adaptive' or 'random', got {strategy!r}"
+            )
         if callable(data):
             data = data()
         x_train, y_train, x_val, y_val = data
         self._best_index = None  # cleared so a failed search can't pair a
         # stale index with freshly assigned trials
         search_space = search_space or {}
-        rng = np.random.default_rng(self.seed)
+        n_proc = jax.process_count()
+        pid = jax.process_index()
+        # distinct stream per process so gang members explore, not repeat
+        base_seed = (self.seed if self.seed is not None else 0) * 1009 + pid
+        rng = np.random.default_rng(base_seed)
+        sampler = (
+            TpeSampler(search_space, seed=base_seed)
+            if strategy == "adaptive"
+            else None
+        )
 
-        # Params are sampled up-front (deterministic given seed); models are
-        # built lazily inside each trial under a lock (Keras layer-naming
-        # state is global) so only in-flight trials hold live models —
-        # memory stays O(concurrency + 1 best), not O(max_evals). Trials
-        # train/evaluate concurrently, one thread per mesh device, each on
-        # its own 1-device mesh, so an 8-device mesh runs 8 trials at a
-        # time instead of leaving 7 devices idle.
+        # Models are built lazily inside each trial under a lock (Keras
+        # layer-naming state is global) so only in-flight trials hold live
+        # models — memory stays O(concurrency + 1 best), not O(max_evals).
+        # Within a round, trials train/evaluate concurrently, one thread
+        # per local device, each on its own 1-device mesh.
+        import queue
         import threading
 
-        trial_params = [sample_space(search_space, rng) for _ in range(max_evals)]
         build_lock = threading.Lock()
         best_lock = threading.Lock()
         best_state: dict = {"loss": float("inf"), "model": None, "index": None}
         # devices are leased from a free pool, not indexed by trial number —
         # heterogeneous trial runtimes would otherwise double-book one
         # device while its neighbor sits idle
-        import queue
-
         free_devices: queue.Queue = queue.Queue()
         for d in self.devices[: self.num_workers]:
             free_devices.put(d)
 
-        def run_trial(i: int) -> Trial:
-            params = trial_params[i]
+        def run_trial(arg) -> Trial:
+            i, params = arg
             with build_lock:
                 trial_model = model(params)
             if getattr(trial_model, "optimizer", None) is None:
@@ -197,24 +387,113 @@ class HyperParamModel:
 
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-            self.trials = list(pool.map(run_trial, range(max_evals)))
+        # round-based: sample (informed) → run concurrently → sync → repeat
+        self.trials = []
+        completed: list[tuple[dict, float]] = []
+        evals_done = 0
+        while evals_done < max_evals:
+            global_batch = min(max_evals - evals_done, self.num_workers * n_proc)
+            my_slots = list(range(pid, global_batch, n_proc))
+            if sampler is not None:
+                batch_params = sampler.sample_batch(len(my_slots), completed)
+            else:
+                batch_params = [
+                    sample_space(search_space, rng) for _ in my_slots
+                ]
+            local_base = len(self.trials)
+            indexed = [
+                (local_base + j, params)
+                for j, params in enumerate(batch_params)
+            ]
+            with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+                round_trials = list(pool.map(run_trial, indexed))
+            self.trials.extend(round_trials)
+            if n_proc > 1:
+                # the gather rides float32; canonicalize local params
+                # through the same round-trip so every process reports
+                # bit-identical winning params
+                for t in round_trials:
+                    t.params = _decode_params(
+                        _encode_params(t.params, search_space), search_space
+                    )
+                local_results = [(t.params, t.loss) for t in round_trials]
+                completed.extend(
+                    self._sync_round(
+                        local_results, len(my_slots), global_batch, search_space
+                    )
+                )
+            else:
+                completed.extend(
+                    (t.params, t.loss) for t in round_trials
+                )
+            evals_done += global_batch
 
-        # the trained model itself is returned — no json/weights round-trip,
-        # so builders using custom layers/objects work unchanged
         best_model = best_state["model"]
-        if best_model is None:
+        global_best = (
+            min(completed, key=lambda t: (not np.isfinite(t[1]), t[1]))
+            if completed
+            else (None, float("inf"))
+        )
+        if best_model is None and not np.isfinite(global_best[1]):
             raise RuntimeError(
                 f"no trial produced a finite validation loss "
                 f"(losses: {[t.loss for t in self.trials]}); the search "
                 f"space likely diverges — narrow the learning-rate range"
             )
+        if np.isfinite(global_best[1]) and global_best[1] < best_state["loss"]:
+            # another process won: retrain its params locally so every
+            # process returns an equivalent best model
+            with build_lock:
+                best_model = model(global_best[0])
+            mesh = Mesh(np.array([self.devices[0]]), ("workers",))
+            runner = MeshRunner(best_model, "synchronous", "epoch", mesh)
+            runner.run_epochs(
+                [(x_train, y_train)], epochs=epochs, batch_size=batch_size
+            )
+            self.trials.append(
+                Trial(params=global_best[0], loss=global_best[1], metrics={})
+            )
+            best_state["loss"] = global_best[1]
+            best_state["index"] = len(self.trials) - 1
         self.best_models = [best_model]
         # the winning trial index is recorded at update time so that
         # best_trial()/best_model_params() name the same trial the
         # returned model came from, even on tied or NaN losses
         self._best_index = best_state["index"]
         return best_model
+
+    def _sync_round(
+        self,
+        local_results: list[tuple[dict, float]],
+        my_k: int,
+        global_batch: int,
+        space: dict,
+    ) -> list[tuple[dict, float]]:
+        """All-gather one round's (params, loss) across the gang.
+
+        Params encode to a float32 vector (numeric dims: value; choice
+        dims: option index) so results ride one array collective; every
+        process decodes the full round for its adaptive sampler.
+        """
+        import jax
+        from jax.experimental import multihost_utils
+
+        keys = sorted(space)
+        max_k = -(-global_batch // max(1, jax.process_count()))
+        mat = np.full((max_k, len(keys) + 1), np.nan, np.float32)
+        for row, (params, loss) in enumerate(local_results[:max_k]):
+            mat[row, : len(keys)] = _encode_params(params, space)
+            mat[row, -1] = loss
+        gathered = np.asarray(multihost_utils.process_allgather(mat))
+
+        out = []
+        for p in range(gathered.shape[0]):
+            for row in range(gathered.shape[1]):
+                vec = gathered[p, row]
+                if np.all(np.isnan(vec)):
+                    continue  # padding row
+                out.append((_decode_params(vec[:-1], space), float(vec[-1])))
+        return out
 
     def best_trial(self) -> Trial:
         if not self.trials:

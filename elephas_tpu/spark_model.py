@@ -135,6 +135,9 @@ class SparkModel:
 
     # -- training ------------------------------------------------------
 
+    # datasets larger than this stage blockwise instead of whole-epoch
+    STREAM_THRESHOLD_BYTES = 1 << 30
+
     def fit(
         self,
         rdd: Rdd,
@@ -146,10 +149,15 @@ class SparkModel:
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 1,
         resume: bool = False,
+        steps_per_epoch: int | None = None,
+        stream_block_steps: int | None = None,
         **kwargs,
     ) -> dict:
-        """Train on a simple RDD of ``(x_row, y_row)`` pairs; returns the
-        Keras-style history dict (also appended to ``training_histories``).
+        """Train on a simple RDD of ``(x_row, y_row)`` pairs — or on an
+        ``(x, y)`` pair of array-likes (``np.ndarray``, ``np.memmap``,
+        ``h5py.Dataset``) for datasets that should not be materialized.
+        Returns the Keras-style history dict (also appended to
+        ``training_histories``).
 
         Beyond the reference's surface (SURVEY.md §5):
 
@@ -158,8 +166,31 @@ class SparkModel:
         - ``checkpoint_dir``/``checkpoint_every``: snapshot model+optimizer
           every N epochs; ``resume=True`` restarts from the latest
           snapshot, training only the remaining epochs.
+        - out-of-core streaming: array-like inputs bigger than
+          ``STREAM_THRESHOLD_BYTES`` (or lazily backed, or with
+          ``stream_block_steps`` set) stream block-by-block through the
+          compiled epoch program instead of staging whole epochs —
+          datasets beyond HBM (and beyond host RAM, for memmap/h5py
+          sources) train with the same math (see
+          :mod:`elephas_tpu.data.streaming`).
         """
         batch_size = batch_size or self.batch_size
+        if not isinstance(rdd, Rdd):
+            x, y = rdd
+            return self._fit_arrays(
+                x,
+                y,
+                epochs,
+                batch_size,
+                verbose,
+                validation_split,
+                profile_dir=profile_dir,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                resume=resume,
+                steps_per_epoch=steps_per_epoch,
+                stream_block_steps=stream_block_steps,
+            )
         if rdd.getNumPartitions() != self.num_workers:
             rdd = rdd.repartition(self.num_workers)
         partitions = rdd_utils.partition_arrays(rdd)
@@ -175,6 +206,54 @@ class SparkModel:
             resume=resume,
         )
 
+    def _fit_arrays(
+        self,
+        x,
+        y,
+        epochs,
+        batch_size,
+        verbose,
+        validation_split,
+        steps_per_epoch=None,
+        stream_block_steps=None,
+        **fit_kwargs,
+    ) -> dict:
+        from elephas_tpu.data.streaming import ShardedStream, estimate_nbytes
+
+        lazily_backed = not type(x) is np.ndarray or not type(y) is np.ndarray
+        should_stream = (
+            stream_block_steps is not None
+            or steps_per_epoch is not None
+            or lazily_backed
+            or estimate_nbytes(x, y) > self.STREAM_THRESHOLD_BYTES
+        )
+        if not should_stream:
+            xs = np.array_split(x, self.num_workers)
+            ys = np.array_split(y, self.num_workers)
+            partitions = [(a, b) for a, b in zip(xs, ys)]
+            return self._fit_partitions(
+                partitions, epochs, batch_size, verbose, validation_split,
+                **fit_kwargs,
+            )
+        n = len(x)
+        val_partitions = None
+        if validation_split and validation_split > 0.0:
+            n_val = min(max(1, int(n * validation_split)), n - 1)
+            val_partitions = [(np.asarray(x[n - n_val :]), np.asarray(y[n - n_val :]))]
+            x, y = x[: n - n_val], y[: n - n_val]
+        stream = ShardedStream(
+            x,
+            y,
+            batch_size,
+            self.num_workers,
+            block_steps=stream_block_steps or 16,
+            steps_per_epoch=steps_per_epoch,
+        )
+        return self._fit_partitions(
+            None, epochs, batch_size, verbose, 0.0,
+            stream=stream, val_partitions=val_partitions, **fit_kwargs,
+        )
+
     def _fit_partitions(
         self,
         partitions,
@@ -186,6 +265,8 @@ class SparkModel:
         checkpoint_dir=None,
         checkpoint_every=1,
         resume=False,
+        stream=None,
+        val_partitions=None,
     ) -> dict:
         runner = self._get_runner()
 
@@ -207,7 +288,6 @@ class SparkModel:
             return history
         epochs = epochs - start_epoch
 
-        val_partitions = None
         if validation_split and validation_split > 0.0:
             # hold out the global tail fraction (keras semantics), then
             # re-shard both sets onto the mesh
@@ -216,7 +296,8 @@ class SparkModel:
             n_val = min(max(1, int(len(x) * validation_split)), len(x) - 1)
             partitions = [(x[: len(x) - n_val], y[: len(y) - n_val])]
             val_partitions = [(x[len(x) - n_val :], y[len(y) - n_val :])]
-        partitions = runner._fit_partitions_to_mesh(partitions)
+        if partitions is not None:
+            partitions = runner._fit_partitions_to_mesh(partitions)
 
         self.start_server()
         try:
@@ -254,9 +335,14 @@ class SparkModel:
 
                 trace_ctx = contextlib.nullcontext()
             with trace_ctx:
-                history = runner.run_epochs(
-                    partitions, epochs, batch_size, verbose, callbacks=callbacks
-                )
+                if stream is not None:
+                    history = runner.run_epochs_stream(
+                        stream, epochs, verbose, callbacks=callbacks
+                    )
+                else:
+                    history = runner.run_epochs(
+                        partitions, epochs, batch_size, verbose, callbacks=callbacks
+                    )
             if checkpoint_dir:
                 # terminal snapshot regardless of checkpoint_every cadence
                 ckpt.save_checkpoint(

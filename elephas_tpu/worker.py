@@ -489,6 +489,91 @@ class MeshRunner:
         self._write_back(tv, ntv, ov)
         return history
 
+    def run_epochs_stream(
+        self,
+        stream,
+        epochs: int,
+        verbose: int = 0,
+        callbacks=None,
+    ) -> dict:
+        """Streamed training: like :meth:`run_epochs` but the epoch arrives
+        as :class:`~elephas_tpu.data.streaming.ShardedStream` blocks that
+        never all live in device memory at once.
+
+        The same compiled epoch program runs per block (same math, same
+        history), with the next block's host gather/`device_put` hidden
+        under the current block's compute by async dispatch. Metric states
+        re-enter the next block divided by the worker count, since the
+        program psums them on exit — additive states round-trip exactly.
+        """
+        if self.frequency == "fit":
+            raise ValueError(
+                "frequency='fit' (train whole fit locally, average once) "
+                "contradicts streaming; use 'epoch' or 'batch'"
+            )
+        metric_objects = self._unwrapped_metrics(
+            *next(self._first_rows(stream))
+        )
+        if self._epoch_fn is None:
+            self._epoch_fn = self._build_epoch_fn(metric_objects)
+        tv, ntv, ov = self._device_state()
+        W = self.num_workers
+
+        def unmerge(leaf):
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                return leaf / W
+            return leaf // W
+
+        history: dict[str, list[float]] = {"loss": []}
+        for epoch in range(epochs):
+            mvs = self._zero_metric_state(metric_objects)
+            losses: list[tuple] = []
+            blocks = stream.blocks()
+            nxt = next(blocks, None)
+            first = True
+            while nxt is not None:
+                xs, ys, steps = nxt
+                xb, yb = self._shard_data(xs), self._shard_data(ys)
+                if not first:
+                    mvs = jax.tree.map(unmerge, mvs)
+                tv, ntv, ov, mvs, loss = self._epoch_fn(tv, ntv, ov, mvs, xb, yb)
+                losses.append((loss, steps))
+                first = False
+                # gather the next chunk while devices chew on this block
+                nxt = next(blocks, None)
+            total_steps = sum(s for _, s in losses)
+            epoch_loss = (
+                sum(float(np.asarray(l)) * s for l, s in losses) / total_steps
+            )
+            history["loss"].append(epoch_loss)
+            for (m, _i, name), mv in zip(metric_objects, mvs):
+                res = m.stateless_result(mv)
+                if isinstance(res, dict):
+                    for k, v in res.items():
+                        history.setdefault(k, []).append(float(np.asarray(v)))
+                else:
+                    history.setdefault(name, []).append(float(np.asarray(res)))
+            if verbose:
+                logger.info(
+                    "epoch %d/%d - loss: %.4f (%d blocks streamed)",
+                    epoch + 1, epochs, epoch_loss, len(losses),
+                )
+            if callbacks:
+                self._write_back(tv, ntv, ov)
+                for cb in callbacks:
+                    cb(epoch, epoch_loss)
+        self._write_back(tv, ntv, ov)
+        return history
+
+    @staticmethod
+    def _first_rows(stream):
+        """A (x_rows, y_rows) sample for metric building, without pulling
+        a whole block."""
+        yield (
+            np.asarray(stream.x[0:1]),
+            np.asarray(stream.y[0:1]),
+        )
+
     def _gather(self, leaf) -> np.ndarray:
         """Full ``[W, ...]`` host value of a worker-sharded leaf; when the
         leaf spans other processes, replicate via an identity jit (XLA
