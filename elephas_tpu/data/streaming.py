@@ -39,7 +39,11 @@ class ShardedStream:
     ``[w·per_w, (w+1)·per_w)`` (the last shard may be short and wraps
     within itself, matching ``stack_worker_batches``). ``steps_per_epoch``
     truncates the epoch (reference ``fit`` has no such knob because Spark
-    partitions are the unit; streaming needs one).
+    partitions are the unit; streaming needs one). ``num_rows`` restricts
+    the stream to the first ``num_rows`` rows *without slicing the
+    source* — a ``validation_split`` over an ``h5py.Dataset`` must not
+    materialize the training span just to drop the tail (h5py fancy
+    slicing is eager, unlike ``np.memmap``).
     """
 
     def __init__(
@@ -50,6 +54,7 @@ class ShardedStream:
         num_workers: int,
         block_steps: int = 16,
         steps_per_epoch: int | None = None,
+        num_rows: int | None = None,
     ):
         if len(x) != len(y):
             raise ValueError(f"x/y row mismatch: {len(x)} vs {len(y)}")
@@ -60,6 +65,11 @@ class ShardedStream:
         self.num_workers = num_workers
         self.block_steps = max(1, block_steps)
         n = len(x)
+        if num_rows is not None:
+            if not 0 < num_rows <= n:
+                raise ValueError(f"num_rows={num_rows} outside (0, {n}]")
+            n = num_rows
+        self.num_rows = n
         per_w = math.ceil(n / num_workers)
         self.starts = [min(w * per_w, n - 1) for w in range(num_workers)]
         self.counts = [

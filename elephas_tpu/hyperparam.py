@@ -190,11 +190,18 @@ class TpeSampler:
         finite = [(p, l) for p, l in history if np.isfinite(l)]
         if len(finite) < self.min_observations:
             return [sample_space(self.space, self.rng) for _ in range(n)]
-        order = sorted(history, key=lambda t: (not np.isfinite(t[1]), t[1]))
-        n_good = max(2, int(np.ceil(self.gamma * len(order))))
-        if len(order) - n_good < 2:
+        # the good quantile is taken over FINITE trials only — when
+        # divergent (NaN/inf) trials outnumber finite ones, an over-full
+        # quantile of the mixed ordering would pull known-bad params into
+        # the 'good' Parzen estimator and steer toward divergence
+        order = sorted(finite, key=lambda t: t[1])
+        n_good = min(
+            max(2, int(np.ceil(self.gamma * len(order)))), len(order)
+        )
+        diverged = [(p, l) for p, l in history if not np.isfinite(l)]
+        good, bad = order[:n_good], order[n_good:] + diverged
+        if len(bad) < 2:
             return [sample_space(self.space, self.rng) for _ in range(n)]
-        good, bad = order[:n_good], order[n_good:]
 
         out = []
         for _ in range(n):

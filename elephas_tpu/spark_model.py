@@ -230,17 +230,25 @@ class SparkModel:
         if not should_stream:
             xs = np.array_split(x, self.num_workers)
             ys = np.array_split(y, self.num_workers)
-            partitions = [(a, b) for a, b in zip(xs, ys)]
+            # fewer rows than workers → empty splits; drop them and let
+            # the runner's partition shaping fill the mesh (same contract
+            # as partition_arrays on the RDD path)
+            partitions = [(a, b) for a, b in zip(xs, ys) if len(a)]
             return self._fit_partitions(
                 partitions, epochs, batch_size, verbose, validation_split,
                 **fit_kwargs,
             )
         n = len(x)
         val_partitions = None
+        num_rows = None
         if validation_split and validation_split > 0.0:
+            # materialize only the (small) validation tail; the train
+            # split stays a lazy view via the stream's num_rows limit —
+            # slicing x[:n-n_val] would stage the whole train span for
+            # eager-slicing sources like h5py.Dataset
             n_val = min(max(1, int(n * validation_split)), n - 1)
             val_partitions = [(np.asarray(x[n - n_val :]), np.asarray(y[n - n_val :]))]
-            x, y = x[: n - n_val], y[: n - n_val]
+            num_rows = n - n_val
         stream = ShardedStream(
             x,
             y,
@@ -248,6 +256,7 @@ class SparkModel:
             self.num_workers,
             block_steps=stream_block_steps or 16,
             steps_per_epoch=steps_per_epoch,
+            num_rows=num_rows,
         )
         return self._fit_partitions(
             None, epochs, batch_size, verbose, 0.0,
@@ -318,7 +327,7 @@ class SparkModel:
 
                 callbacks.append(save_ckpt)
             val_history: dict[str, list[float]] = {}
-            if val_partitions is not None:
+            if val_partitions is not None and self.frequency != "fit":
                 # per-epoch validation, like keras.fit's val_* history
                 def eval_cb(_epoch, _loss):
                     for k, v in runner.evaluate(val_partitions, batch_size).items():
@@ -343,6 +352,13 @@ class SparkModel:
                     history = runner.run_epochs(
                         partitions, epochs, batch_size, verbose, callbacks=callbacks
                     )
+            if val_partitions is not None and self.frequency == "fit":
+                # 'fit' averages worker weights only once, after the epoch
+                # loop — per-epoch callbacks would evaluate worker-0's
+                # un-averaged replica, so validate once against the final
+                # averaged model instead
+                for k, v in runner.evaluate(val_partitions, batch_size).items():
+                    val_history[f"val_{k}"] = [v]
             if checkpoint_dir:
                 # terminal snapshot regardless of checkpoint_every cadence
                 ckpt.save_checkpoint(

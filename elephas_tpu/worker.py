@@ -502,9 +502,11 @@ class MeshRunner:
 
         The same compiled epoch program runs per block (same math, same
         history), with the next block's host gather/`device_put` hidden
-        under the current block's compute by async dispatch. Metric states
-        re-enter the next block divided by the worker count, since the
-        program psums them on exit — additive states round-trip exactly.
+        under the current block's compute by async dispatch. Each block
+        enters with zero metric state and leaves its psum'd (cross-worker
+        additive) contribution, which accumulates across blocks — exact
+        for integer and float states alike (a divide-by-W re-entry would
+        silently truncate integer counters at every block boundary).
         """
         if self.frequency == "fit":
             raise ValueError(
@@ -517,28 +519,26 @@ class MeshRunner:
         if self._epoch_fn is None:
             self._epoch_fn = self._build_epoch_fn(metric_objects)
         tv, ntv, ov = self._device_state()
-        W = self.num_workers
-
-        def unmerge(leaf):
-            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
-                return leaf / W
-            return leaf // W
 
         history: dict[str, list[float]] = {"loss": []}
         for epoch in range(epochs):
-            mvs = self._zero_metric_state(metric_objects)
+            mvs = None  # accumulated block contributions (additive states)
             losses: list[tuple] = []
             blocks = stream.blocks()
             nxt = next(blocks, None)
-            first = True
             while nxt is not None:
                 xs, ys, steps = nxt
                 xb, yb = self._shard_data(xs), self._shard_data(ys)
-                if not first:
-                    mvs = jax.tree.map(unmerge, mvs)
-                tv, ntv, ov, mvs, loss = self._epoch_fn(tv, ntv, ov, mvs, xb, yb)
+                zero_mvs = self._zero_metric_state(metric_objects)
+                tv, ntv, ov, block_mvs, loss = self._epoch_fn(
+                    tv, ntv, ov, zero_mvs, xb, yb
+                )
+                mvs = (
+                    block_mvs
+                    if mvs is None
+                    else jax.tree.map(jnp.add, mvs, block_mvs)
+                )
                 losses.append((loss, steps))
-                first = False
                 # gather the next chunk while devices chew on this block
                 nxt = next(blocks, None)
             total_steps = sum(s for _, s in losses)

@@ -160,6 +160,21 @@ def test_adaptive_concentrates_choice():
     assert frac64 > 0.7, frac64
 
 
+def test_tpe_divergent_majority_stays_bad():
+    """ADVICE r2 (low): when divergent (NaN) trials outnumber finite ones,
+    the 'good' Parzen estimator must be built from finite trials only —
+    diverged params must not steer sampling toward their region."""
+    from elephas_tpu.hyperparam import TpeSampler
+
+    space = {"x": uniform(0.0, 1.0)}
+    history = [({"x": 0.1 + 0.01 * i}, 0.1 * (i + 1)) for i in range(4)]
+    history += [({"x": 0.9 + 0.001 * i}, float("nan")) for i in range(36)]
+    sampler = TpeSampler(space, seed=0)
+    batch = sampler.sample_batch(40, history)
+    vals = np.array([p["x"] for p in batch])
+    assert np.mean(vals < 0.5) > 0.8, vals
+
+
 def test_minimize_random_strategy(blobs):
     """The reference-parity random path stays available."""
     x, y, d, k = blobs

@@ -187,6 +187,22 @@ def test_val_history_per_epoch(spark_context, blobs):
     assert history["val_loss"][-1] < history["val_loss"][0]
 
 
+def test_frequency_fit_validates_averaged_model(spark_context, blobs):
+    """ADVICE r2 (low): with frequency='fit', workers average only once
+    after the epoch loop — validation must run against the final averaged
+    model, not worker-0's un-averaged replica per epoch."""
+    x, y, d, k = blobs
+    model = make_mlp(d, k, seed=27)
+    spark_model = SparkModel(model, frequency="fit", num_workers=8)
+    rdd = to_simple_rdd(spark_context, x, y)
+    history = spark_model.fit(rdd, epochs=2, batch_size=32, validation_split=0.2)
+    assert len(history["val_loss"]) == 1
+    # the recorded val_loss must be the averaged final model's: recompute
+    n_val = int(len(x) * 0.2)
+    post = spark_model.evaluate(x[-n_val:], y[-n_val:], batch_size=32)
+    assert abs(history["val_loss"][0] - post[0]) < 1e-5, (history, post)
+
+
 def test_two_output_model_evaluates(spark_context, blobs):
     """r2: multi-output/multi-loss models must evaluate distributed with
     keras-parity values and key order (VERDICT r1 weak #6/#8)."""

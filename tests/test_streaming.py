@@ -96,6 +96,91 @@ def test_estimate_nbytes_lazy():
     assert estimate_nbytes(x, y) == 100 * 16 + 400
 
 
+class _EagerSource:
+    """h5py-style source: eager fancy indexing (slices materialize), with
+    the largest single materialization recorded."""
+
+    def __init__(self, a):
+        self._a = a
+        self.max_rows = 0
+
+    def __len__(self):
+        return len(self._a)
+
+    def __getitem__(self, idx):
+        rows = np.asarray(self._a[idx])
+        if rows.ndim == self._a.ndim:
+            self.max_rows = max(self.max_rows, rows.shape[0])
+        return rows
+
+
+def test_validation_split_keeps_train_split_lazy(blobs):
+    """ADVICE r2 (medium): validation_split over an eager-slicing lazy
+    source must materialize only the validation tail + per-block chunks,
+    never the whole training span."""
+    x, y, d, k = blobs
+    xs, ys = _EagerSource(x), _EagerSource(y)
+    sm = SparkModel(make_mlp(d, k, seed=21), num_workers=8)
+    history = sm.fit(
+        (xs, ys), epochs=2, batch_size=32, validation_split=0.2,
+        stream_block_steps=2,
+    )
+    assert len(history["val_loss"]) == 2
+    n_val = int(len(x) * 0.2)
+    # the biggest materialization is the validation tail; block gathers
+    # are 2 steps x 32 rows per worker
+    assert xs.max_rows <= n_val, xs.max_rows
+    # streamed train split respects the num_rows limit
+    assert history["loss"][-1] < history["loss"][0]
+
+
+def test_streamed_integer_metric_state_exact(blobs):
+    """ADVICE r2 (low): integer metric state must accumulate exactly
+    across block boundaries (the old divide-by-W re-entry truncated)."""
+    import keras
+
+    x, y, d, k = blobs
+    x, y = x[:1280], y[:1280]
+
+    class IntCorrect(keras.metrics.Metric):
+        """Correct-prediction counter with int32 state — per-worker counts
+        are not multiples of W, so floor division loses remainders."""
+
+        def __init__(self, name="int_correct", **kw):
+            super().__init__(name=name, **kw)
+            self.count = self.add_weight(
+                name="c", initializer="zeros", dtype="int32"
+            )
+
+        def update_state(self, y_true, y_pred, sample_weight=None):
+            hits = keras.ops.cast(
+                keras.ops.equal(
+                    keras.ops.cast(y_true, "int32"),
+                    keras.ops.cast(keras.ops.argmax(y_pred, -1), "int32"),
+                ),
+                "int32",
+            )
+            self.count.assign_add(keras.ops.sum(hits))
+
+        def result(self):
+            return self.count
+
+    def build(seed):
+        model = make_mlp(d, k, seed=seed)
+        model.compile(
+            optimizer=keras.optimizers.Adam(1e-2),
+            loss="sparse_categorical_crossentropy",
+            metrics=["accuracy", IntCorrect()],
+        )
+        return model
+
+    staged = SparkModel(build(23), num_workers=8)
+    h1 = staged.fit((x, y), epochs=2, batch_size=32)
+    streamed = SparkModel(build(23), num_workers=8)
+    h2 = streamed.fit((x, y), epochs=2, batch_size=32, stream_block_steps=2)
+    assert h1["int_correct"] == h2["int_correct"], (h1, h2)
+
+
 def test_stream_frequency_fit_rejected(blobs):
     x, y, d, k = blobs
     sm = SparkModel(make_mlp(d, k), frequency="fit", num_workers=8)
