@@ -92,7 +92,11 @@ class ShardedStream:
         lo = step_lo * self.batch_size
         hi = step_hi * self.batch_size
         idx = start + (np.arange(lo, hi) % count)
-        rows = np.asarray(source[idx])
+        # wrap-padding makes idx non-monotonic with duplicates; h5py point
+        # selection demands strictly-increasing unique indices, so gather
+        # the sorted-unique rows and remap (no-op cost for numpy/memmap)
+        uniq, inverse = np.unique(idx, return_inverse=True)
+        rows = np.asarray(source[uniq])[inverse]
         return rows.reshape(
             (step_hi - step_lo, self.batch_size) + rows.shape[1:]
         )

@@ -45,6 +45,7 @@ class SparkModel:
         custom_objects: dict | None = None,
         batch_size: int = 32,
         port: int = 4000,
+        model_parallel: int = 1,
         *args,
         **kwargs,
     ):
@@ -71,11 +72,30 @@ class SparkModel:
         self.custom_objects = custom_objects
         self.batch_size = batch_size
         self.port = port
+        self.model_parallel = int(model_parallel)
         self.kwargs = kwargs
 
-        self.mesh = worker_mesh(num_workers)
-        self.num_workers = self.mesh.devices.size
-        self._runner: MeshRunner | None = None
+        if self.model_parallel > 1:
+            # models bigger than one chip: 2-D ('data', 'model') mesh —
+            # workers are the data-axis replicas (the reference's
+            # fit-one-worker ceiling removed; SURVEY.md §2a TP row)
+            from elephas_tpu.parallel.tensor import dp_tp_mesh
+
+            import jax
+
+            max_dp = len(jax.devices()) // self.model_parallel
+            if max_dp < 1:
+                raise ValueError(
+                    f"model_parallel={model_parallel} exceeds the "
+                    f"{len(jax.devices())} available devices"
+                )
+            dp = min(num_workers, max_dp) if num_workers else max_dp
+            self.mesh = dp_tp_mesh(self.model_parallel, data_parallel=dp)
+            self.num_workers = self.mesh.shape["data"]
+        else:
+            self.mesh = worker_mesh(num_workers)
+            self.num_workers = self.mesh.devices.size
+        self._runner = None
         self._parameter_server = None
         self.training_histories: list[dict] = []
 
@@ -98,6 +118,7 @@ class SparkModel:
             "num_workers": self.num_workers,
             "batch_size": self.batch_size,
             "port": self.port,
+            "model_parallel": self.model_parallel,
         }
 
     # -- parameter server (API parity; see module docstring) -----------
@@ -131,7 +152,7 @@ class SparkModel:
 
     def _publish_weights(self) -> None:
         if self._parameter_server is not None:
-            self._parameter_server.set_weights(self._master_network.get_weights())
+            self._parameter_server.set_weights(self._get_runner().host_weights())
 
     # -- training ------------------------------------------------------
 
@@ -281,11 +302,7 @@ class SparkModel:
 
         start_epoch = 0
         if checkpoint_dir and resume:
-            from elephas_tpu.utils import checkpoint as ckpt
-
-            meta = ckpt.restore_checkpoint(
-                self._master_network, checkpoint_dir, self.custom_objects
-            )
+            meta = runner.restore_checkpoint(checkpoint_dir, self.custom_objects)
             if meta is not None:
                 start_epoch = int(meta["epoch"])
                 logger.info(
@@ -316,14 +333,11 @@ class SparkModel:
                 # (run_epochs syncs the master model before each callback)
                 callbacks.append(lambda *_: self._publish_weights())
             if checkpoint_dir:
-                from elephas_tpu.utils import checkpoint as ckpt
 
                 def save_ckpt(epoch, _loss):
                     done = start_epoch + epoch + 1
                     if done % checkpoint_every == 0:
-                        ckpt.save_checkpoint(
-                            self._master_network, checkpoint_dir, done
-                        )
+                        runner.save_checkpoint(checkpoint_dir, done)
 
                 callbacks.append(save_ckpt)
             val_history: dict[str, list[float]] = {}
@@ -361,12 +375,7 @@ class SparkModel:
                     val_history[f"val_{k}"] = [v]
             if checkpoint_dir:
                 # terminal snapshot regardless of checkpoint_every cadence
-                ckpt.save_checkpoint(
-                    self._master_network,
-                    checkpoint_dir,
-                    start_epoch + epochs,
-                    history,
-                )
+                runner.save_checkpoint(checkpoint_dir, start_epoch + epochs, history)
             history.update(val_history)
             self._publish_weights()
         finally:
@@ -440,11 +449,18 @@ class SparkModel:
         with open(file_name + ".elephas.json", "w") as f:
             json.dump(self.get_config(), f)
 
-    def _get_runner(self) -> MeshRunner:
+    def _get_runner(self):
         if self._runner is None:
-            self._runner = MeshRunner(
-                self._master_network, self.mode, self.frequency, self.mesh
-            )
+            if self.model_parallel > 1:
+                from elephas_tpu.parallel.tensor import TensorParallelRunner
+
+                self._runner = TensorParallelRunner(
+                    self._master_network, self.mode, self.frequency, self.mesh
+                )
+            else:
+                self._runner = MeshRunner(
+                    self._master_network, self.mode, self.frequency, self.mesh
+                )
         return self._runner
 
 
@@ -494,4 +510,5 @@ def load_spark_model(file_name: str) -> SparkModel:
         num_workers=config.get("num_workers"),
         batch_size=config.get("batch_size", 32),
         port=config.get("port", 4000),
+        model_parallel=config.get("model_parallel", 1),
     )

@@ -108,111 +108,13 @@ def stack_worker_batches(
     return xs, ys, counts, nb
 
 
-class MeshRunner:
-    """Owns the compiled train/eval/predict programs for one Keras model.
+class KerasIntrospection:
+    """Loss/metric introspection over a compiled Keras model — shared by
+    :class:`MeshRunner` (DP over a ``('workers',)`` mesh) and
+    :class:`~elephas_tpu.parallel.tensor.ShardedTrainer` (DP×TP over a
+    ``('data', 'model')`` mesh). Subclasses provide ``self.model``."""
 
-    The model must be compiled (optimizer/loss/metrics) and built. All
-    programs are cached per (static-shape) signature, so repeated ``fit``
-    epochs reuse one executable.
-    """
-
-    def __init__(self, model, mode: str, frequency: str, mesh: Mesh):
-        if mode not in MODES:
-            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
-        if frequency not in FREQUENCIES:
-            raise ValueError(
-                f"frequency must be one of {FREQUENCIES}, got {frequency!r}"
-            )
-        self.model = model
-        self.mode = mode
-        self.frequency = frequency
-        self.mesh = mesh
-        self.num_workers = mesh.devices.size
-        self._epoch_fn = None
-        self._eval_fn = None
-        self._predict_fn = None
-        self._gather_fn = None
-        model.optimizer.build(model.trainable_variables)
-
-    # -- state plumbing ------------------------------------------------
-
-    def _host_state(self):
-        tv = [np.asarray(v.value) for v in self.model.trainable_variables]
-        ntv = [np.asarray(v.value) for v in self.model.non_trainable_variables]
-        ov = [np.asarray(v.value) for v in self.model.optimizer.variables]
-        return tv, ntv, ov
-
-    def _local_worker_indices(self) -> list[int]:
-        """Mesh positions whose device belongs to this process (multi-host:
-        the workers whose data/state this process stages)."""
-        pid = jax.process_index()
-        return [
-            i
-            for i, d in enumerate(self.mesh.devices.flat)
-            if d.process_index == pid
-        ]
-
-    def _device_state(self, stacked: bool = True):
-        """Current model state, replicated to ``[W, ...]`` worker shards.
-
-        Multi-host: each process materializes only its addressable
-        workers' slices (``jax.make_array_from_process_local_data``); the
-        global array spans the pod without any host holding all of it.
-        """
-        W = self.num_workers
-        sharding = NamedSharding(self.mesh, P("workers"))
-        tv, ntv, ov = self._host_state()
-        multiproc = jax.process_count() > 1
-        n_local = len(self._local_worker_indices()) if multiproc else W
-
-        def rep(leaf):
-            local = np.broadcast_to(leaf[None], (n_local,) + leaf.shape)
-            if multiproc:
-                return jax.make_array_from_process_local_data(
-                    sharding, local, (W,) + leaf.shape
-                )
-            return jax.device_put(local, sharding)
-
-        return (
-            [rep(l) for l in tv],
-            [rep(l) for l in ntv],
-            [rep(l) for l in ov],
-        )
-
-    def _shard_data(self, arr: np.ndarray):
-        sharding = NamedSharding(self.mesh, P("workers"))
-        if jax.process_count() > 1:
-            local = arr[np.asarray(self._local_worker_indices())]
-            return jax.make_array_from_process_local_data(
-                sharding, local, arr.shape
-            )
-        return jax.device_put(arr, sharding)
-
-    @staticmethod
-    def _worker_slice(leaf, index: int = 0):
-        """One worker's slice of a ``[W, ...]``-sharded leaf. Multi-host,
-        leaves span non-addressable devices — read the first local shard
-        instead (all replicas agree post-sync)."""
-        if getattr(leaf, "is_fully_addressable", True):
-            return np.asarray(leaf[index])
-        return np.asarray(leaf.addressable_shards[0].data)[0]
-
-    def _write_back(self, tv, ntv, ov=None):
-        """Worker-0 slice → model variables (all replicas agree post-sync)."""
-        for var, leaf in zip(self.model.trainable_variables, tv):
-            var.assign(self._worker_slice(leaf))
-        for var, leaf in zip(self.model.non_trainable_variables, ntv):
-            var.assign(self._worker_slice(leaf))
-        if ov is not None:
-            for var, leaf in zip(self.model.optimizer.variables, ov):
-                var.assign(self._worker_slice(leaf))
-
-    # -- loss helpers --------------------------------------------------
-
-    def _loss_and_updates(self, tv, ntv, x, y):
-        y_pred, ntv2 = self.model.stateless_call(tv, ntv, x, training=True)
-        loss = self.model.compute_loss(x=x, y=y, y_pred=y_pred)
-        return loss, (ntv2, y_pred)
+    model = None  # set by subclass __init__
 
     def _output_names(self) -> list[str]:
         names = list(getattr(self.model, "output_names", []) or [])
@@ -344,6 +246,140 @@ class MeshRunner:
                 mm.reset_state()
         return out
 
+    def _loss_keys(self) -> list[str]:
+        """Reported loss keys, in keras order: total first, then per-output."""
+        loss = self.model.loss
+        names = self._output_names()
+        if isinstance(loss, dict):
+            return ["loss"] + [f"{n}_loss" for n in names if n in loss]
+        if isinstance(loss, (list, tuple)):
+            return ["loss"] + [f"{n}_loss" for n in names]
+        return ["loss"]
+
+    def _zero_metric_state(self, metric_objects):
+        """Fresh metric variables as host zeros."""
+        return [
+            [np.zeros(v.shape, v.dtype) for v in m.variables]
+            for m, _i, _n in metric_objects
+        ]
+
+    def _history_from_metrics(self, history, metric_objects, mvs):
+        """Append one epoch's metric results to a history dict."""
+        for (m, _i, name), mv in zip(metric_objects, mvs):
+            res = m.stateless_result(mv)
+            if isinstance(res, dict):
+                for k, v in res.items():
+                    history.setdefault(k, []).append(float(np.asarray(v)))
+            else:
+                history.setdefault(name, []).append(float(np.asarray(res)))
+
+
+class MeshRunner(KerasIntrospection):
+    """Owns the compiled train/eval/predict programs for one Keras model.
+
+    The model must be compiled (optimizer/loss/metrics) and built. All
+    programs are cached per (static-shape) signature, so repeated ``fit``
+    epochs reuse one executable.
+    """
+
+    def __init__(self, model, mode: str, frequency: str, mesh: Mesh):
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        if frequency not in FREQUENCIES:
+            raise ValueError(
+                f"frequency must be one of {FREQUENCIES}, got {frequency!r}"
+            )
+        self.model = model
+        self.mode = mode
+        self.frequency = frequency
+        self.mesh = mesh
+        self.num_workers = mesh.devices.size
+        self._epoch_fn = None
+        self._eval_fn = None
+        self._predict_fn = None
+        self._gather_fn = None
+        model.optimizer.build(model.trainable_variables)
+
+    # -- state plumbing ------------------------------------------------
+
+    def _host_state(self):
+        tv = [np.asarray(v.value) for v in self.model.trainable_variables]
+        ntv = [np.asarray(v.value) for v in self.model.non_trainable_variables]
+        ov = [np.asarray(v.value) for v in self.model.optimizer.variables]
+        return tv, ntv, ov
+
+    def _local_worker_indices(self) -> list[int]:
+        """Mesh positions whose device belongs to this process (multi-host:
+        the workers whose data/state this process stages)."""
+        pid = jax.process_index()
+        return [
+            i
+            for i, d in enumerate(self.mesh.devices.flat)
+            if d.process_index == pid
+        ]
+
+    def _device_state(self, stacked: bool = True):
+        """Current model state, replicated to ``[W, ...]`` worker shards.
+
+        Multi-host: each process materializes only its addressable
+        workers' slices (``jax.make_array_from_process_local_data``); the
+        global array spans the pod without any host holding all of it.
+        """
+        W = self.num_workers
+        sharding = NamedSharding(self.mesh, P("workers"))
+        tv, ntv, ov = self._host_state()
+        multiproc = jax.process_count() > 1
+        n_local = len(self._local_worker_indices()) if multiproc else W
+
+        def rep(leaf):
+            local = np.broadcast_to(leaf[None], (n_local,) + leaf.shape)
+            if multiproc:
+                return jax.make_array_from_process_local_data(
+                    sharding, local, (W,) + leaf.shape
+                )
+            return jax.device_put(local, sharding)
+
+        return (
+            [rep(l) for l in tv],
+            [rep(l) for l in ntv],
+            [rep(l) for l in ov],
+        )
+
+    def _shard_data(self, arr: np.ndarray):
+        sharding = NamedSharding(self.mesh, P("workers"))
+        if jax.process_count() > 1:
+            local = arr[np.asarray(self._local_worker_indices())]
+            return jax.make_array_from_process_local_data(
+                sharding, local, arr.shape
+            )
+        return jax.device_put(arr, sharding)
+
+    @staticmethod
+    def _worker_slice(leaf, index: int = 0):
+        """One worker's slice of a ``[W, ...]``-sharded leaf. Multi-host,
+        leaves span non-addressable devices — read the first local shard
+        instead (all replicas agree post-sync)."""
+        if getattr(leaf, "is_fully_addressable", True):
+            return np.asarray(leaf[index])
+        return np.asarray(leaf.addressable_shards[0].data)[0]
+
+    def _write_back(self, tv, ntv, ov=None):
+        """Worker-0 slice → model variables (all replicas agree post-sync)."""
+        for var, leaf in zip(self.model.trainable_variables, tv):
+            var.assign(self._worker_slice(leaf))
+        for var, leaf in zip(self.model.non_trainable_variables, ntv):
+            var.assign(self._worker_slice(leaf))
+        if ov is not None:
+            for var, leaf in zip(self.model.optimizer.variables, ov):
+                var.assign(self._worker_slice(leaf))
+
+    # -- loss helpers --------------------------------------------------
+
+    def _loss_and_updates(self, tv, ntv, x, y):
+        y_pred, ntv2 = self.model.stateless_call(tv, ntv, x, training=True)
+        loss = self.model.compute_loss(x=x, y=y, y_pred=y_pred)
+        return loss, (ntv2, y_pred)
+
     # -- training ------------------------------------------------------
 
     def _build_epoch_fn(self, metric_objects=None):
@@ -411,14 +447,6 @@ class MeshRunner:
         )
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
-    def _zero_metric_state(self, metric_objects):
-        """Fresh metric variables (host zeros; replicated into the
-        program via the P() in_spec — identical on every process)."""
-        return [
-            [np.zeros(v.shape, v.dtype) for v in m.variables]
-            for m, _i, _n in metric_objects
-        ]
-
     def run_epochs(
         self,
         partitions: list[tuple[np.ndarray, np.ndarray]],
@@ -454,13 +482,7 @@ class MeshRunner:
             tv, ntv, ov, mvs, loss = self._epoch_fn(tv, ntv, ov, mvs, xb, yb)
             epoch_loss = float(np.asarray(loss))  # replicated: direct read
             history["loss"].append(epoch_loss)
-            for (m, _i, name), mv in zip(metric_objects, mvs):
-                res = m.stateless_result(mv)
-                if isinstance(res, dict):
-                    for k, v in res.items():
-                        history.setdefault(k, []).append(float(np.asarray(v)))
-                else:
-                    history.setdefault(name, []).append(float(np.asarray(res)))
+            self._history_from_metrics(history, metric_objects, mvs)
             if verbose:
                 logger.info("epoch %d/%d - loss: %.4f", epoch + 1, epochs, epoch_loss)
             if callbacks:
@@ -546,13 +568,7 @@ class MeshRunner:
                 sum(float(np.asarray(l)) * s for l, s in losses) / total_steps
             )
             history["loss"].append(epoch_loss)
-            for (m, _i, name), mv in zip(metric_objects, mvs):
-                res = m.stateless_result(mv)
-                if isinstance(res, dict):
-                    for k, v in res.items():
-                        history.setdefault(k, []).append(float(np.asarray(v)))
-                else:
-                    history.setdefault(name, []).append(float(np.asarray(res)))
+            self._history_from_metrics(history, metric_objects, mvs)
             if verbose:
                 logger.info(
                     "epoch %d/%d - loss: %.4f (%d blocks streamed)",
@@ -640,16 +656,6 @@ class MeshRunner:
         )
         return jax.jit(sharded)
 
-    def _loss_keys(self) -> list[str]:
-        """Reported loss keys, in keras order: total first, then per-output."""
-        loss = self.model.loss
-        names = self._output_names()
-        if isinstance(loss, dict):
-            return ["loss"] + [f"{n}_loss" for n in names if n in loss]
-        if isinstance(loss, (list, tuple)):
-            return ["loss"] + [f"{n}_loss" for n in names]
-        return ["loss"]
-
     def evaluate(
         self,
         partitions: list[tuple[np.ndarray, np.ndarray]],
@@ -698,14 +704,31 @@ class MeshRunner:
         results = {
             k: float(np.asarray(loss_sums[k])) / denom for k in loss_keys
         }
-        for (m, _i, name), mv in zip(metric_objects, mvs):
-            res = m.stateless_result(mv)
-            if isinstance(res, dict):
-                for k, v in res.items():
-                    results[k] = float(np.asarray(v))
-            else:
-                results[name] = float(np.asarray(res))
+        tail: dict[str, list[float]] = {}
+        self._history_from_metrics(tail, metric_objects, mvs)
+        results.update({k: v[0] for k, v in tail.items()})
         return results
+
+    def host_weights(self):
+        """Full weights on host for parameter-server publication (the
+        wire protocol is host numpy lists by contract). Current because
+        run_epochs writes back before callbacks fire."""
+        return self.model.get_weights()
+
+    # -- checkpointing (runner-dispatched; SparkModel stays agnostic) ----
+
+    def save_checkpoint(self, directory: str, epoch: int, history=None) -> None:
+        """Whole-model keras archive — data-parallel replicas are
+        identical post-sync, so one archive is the canonical state.
+        (The TP runner overrides this with per-shard orbax snapshots.)"""
+        from elephas_tpu.utils import checkpoint as ckpt
+
+        ckpt.save_checkpoint(self.model, directory, epoch, history)
+
+    def restore_checkpoint(self, directory: str, custom_objects=None):
+        from elephas_tpu.utils import checkpoint as ckpt
+
+        return ckpt.restore_checkpoint(self.model, directory, custom_objects)
 
     # -- prediction ----------------------------------------------------
 

@@ -134,6 +134,32 @@ def test_validation_split_keeps_train_split_lazy(blobs):
     assert history["loss"][-1] < history["loss"][0]
 
 
+class _StrictSource(_EagerSource):
+    """h5py-faithful: point selection requires strictly increasing,
+    duplicate-free index arrays."""
+
+    def __getitem__(self, idx):
+        if isinstance(idx, np.ndarray):
+            if len(idx) > 1 and not (np.diff(idx) > 0).all():
+                raise TypeError("Indexing elements must be in increasing order")
+        return super().__getitem__(idx)
+
+
+def test_h5py_style_source_streams(blobs):
+    """Wrap-padding must not hand lazy sources non-monotonic fancy
+    indices — h5py rejects them (code-review r3 finding)."""
+    x, y, d, k = blobs
+    # 1500 rows / 8 workers = 188-per-worker shards: not a batch multiple,
+    # so the final block wraps and the raw index array is non-monotonic
+    xs, ys = _StrictSource(x[:1500]), _StrictSource(y[:1500])
+    sm = SparkModel(make_mlp(d, k, seed=29), num_workers=8)
+    history = sm.fit(
+        (xs, ys), epochs=2, batch_size=32, validation_split=0.2,
+        stream_block_steps=2,
+    )
+    assert history["loss"][-1] < history["loss"][0]
+
+
 def test_streamed_integer_metric_state_exact(blobs):
     """ADVICE r2 (low): integer metric state must accumulate exactly
     across block boundaries (the old divide-by-W re-entry truncated)."""
