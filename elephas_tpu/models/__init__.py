@@ -14,6 +14,7 @@ from elephas_tpu.models.convnet import cifar10_cnn
 from elephas_tpu.models.lstm import imdb_lstm
 from elephas_tpu.models.resnet import resnet50, resnet
 from elephas_tpu.models.transformer import transformer_classifier, transformer_lm
+from elephas_tpu.models.switch import switch_transformer_classifier
 
 __all__ = [
     "mnist_mlp",
@@ -23,4 +24,15 @@ __all__ = [
     "resnet",
     "transformer_classifier",
     "transformer_lm",
+    "switch_transformer_classifier",
+    "MoeFFN",
 ]
+
+
+def __getattr__(name):
+    # lazily resolve layer classes that require keras at definition time
+    if name == "MoeFFN":
+        from elephas_tpu.models.switch import MoeFFN
+
+        return MoeFFN
+    raise AttributeError(name)

@@ -1,0 +1,213 @@
+"""Switch/GShard-style Mixture-of-Experts transformer — zoo member.
+
+The reference has no MoE (SURVEY.md §2a lists expert parallelism as
+absent); this is the TPU-native extension in its user-facing form:
+
+- :class:`MoeFFN` — a Keras layer wrapping the routing/capacity math of
+  :mod:`elephas_tpu.ops.moe` (top-k routing, Switch §2.2 load-balance
+  auxiliary loss via ``add_loss``), so any ``SparkModel``-trained model
+  can use experts.
+- :func:`switch_transformer_classifier` — a transformer encoder whose
+  FFN blocks are MoE layers, compiled and ready for ``SparkModel``.
+
+Under data-parallel training experts replicate per worker (each worker
+routes its own tokens). Under ``SparkModel(model_parallel=N)`` the
+planner's expert rules shard the ``[E, ...]`` expert weights over the
+``model`` axis — GSPMD places the token all-to-all, giving true expert
+parallelism through the same layer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_MOE_FFN_CLS = None
+
+
+def _moe_ffn_layer():
+    """The MoeFFN layer class, created lazily (keras under the jax
+    backend) and registered with Keras's serializer."""
+    global _MOE_FFN_CLS
+    if _MOE_FFN_CLS is not None:
+        return _MOE_FFN_CLS
+    import keras
+    import jax.numpy as jnp
+
+    from elephas_tpu.ops.moe import _topk_dispatch
+
+    @keras.saving.register_keras_serializable(package="elephas_tpu")
+    class MoeFFN(keras.layers.Layer):
+        """Mixture-of-Experts FFN: top-k routed, capacity-bounded, with
+        the Switch load-balance loss added during training.
+
+        Replaces a transformer block's dense FFN. Input ``[B, S, D]``
+        (or ``[T, D]``); output same shape. Dropped tokens (capacity
+        overflow) output zero — wrap the layer with a residual
+        connection, as in Switch.
+        """
+
+        def __init__(
+            self,
+            num_experts: int,
+            d_hidden: int,
+            k: int = 2,
+            capacity_factor: float = 1.25,
+            aux_weight: float = 1e-2,
+            activation: str = "gelu",
+            **kwargs,
+        ):
+            super().__init__(**kwargs)
+            self.num_experts = num_experts
+            self.d_hidden = d_hidden
+            self.k = k
+            self.capacity_factor = capacity_factor
+            self.aux_weight = aux_weight
+            self.activation = activation
+
+        def build(self, input_shape):
+            d = int(input_shape[-1])
+            e, h = self.num_experts, self.d_hidden
+            init = keras.initializers.VarianceScaling(2.0, "fan_in", "truncated_normal")
+            self.gate_kernel = self.add_weight(
+                name="gate_kernel", shape=(d, e), initializer="glorot_uniform"
+            )
+            self.expert_w1 = self.add_weight(
+                name="expert_w1", shape=(e, d, h), initializer=init
+            )
+            self.expert_b1 = self.add_weight(
+                name="expert_b1", shape=(e, h), initializer="zeros"
+            )
+            self.expert_w2 = self.add_weight(
+                name="expert_w2", shape=(e, h, d), initializer=init
+            )
+            self.expert_b2 = self.add_weight(
+                name="expert_b2", shape=(e, d), initializer="zeros"
+            )
+            super().build(input_shape)
+
+        def call(self, x, training=None):
+            act = keras.activations.get(self.activation)
+            shape = x.shape
+            d = shape[-1]
+            tokens = x
+            if len(shape) == 3:
+                tokens = jnp.reshape(x, (-1, d))
+            t = tokens.shape[0]
+            capacity = max(
+                1,
+                int(self.k * t * self.capacity_factor / self.num_experts),
+            )
+            dispatch, combine, aux = _topk_dispatch(
+                tokens, self.gate_kernel, self.num_experts, capacity, k=self.k
+            )
+            expert_inputs = jnp.einsum("td,tec->ecd", tokens, dispatch)
+            h = act(
+                jnp.einsum("ecd,edh->ech", expert_inputs, self.expert_w1)
+                + self.expert_b1[:, None, :]
+            )
+            out = (
+                jnp.einsum("ech,ehd->ecd", h, self.expert_w2)
+                + self.expert_b2[:, None, :]
+            )
+            out = jnp.einsum("ecd,tec->td", out, combine)
+            if training:
+                self.add_loss(self.aux_weight * aux)
+            if len(shape) == 3:
+                out = jnp.reshape(out, (-1, shape[1], d))
+            return out
+
+        def compute_output_shape(self, input_shape):
+            # shape-preserving; capacity math needs concrete token counts,
+            # so keras must not trace call() symbolically
+            return input_shape
+
+        def get_config(self):
+            config = super().get_config()
+            config.update(
+                num_experts=self.num_experts,
+                d_hidden=self.d_hidden,
+                k=self.k,
+                capacity_factor=self.capacity_factor,
+                aux_weight=self.aux_weight,
+                activation=self.activation,
+            )
+            return config
+
+    _MOE_FFN_CLS = MoeFFN
+    return MoeFFN
+
+
+def __getattr__(name):
+    if name == "MoeFFN":
+        return _moe_ffn_layer()
+    raise AttributeError(name)
+
+
+def switch_transformer_classifier(
+    vocab_size: int = 20000,
+    maxlen: int = 128,
+    num_classes: int = 2,
+    d_model: int = 128,
+    num_heads: int = 4,
+    num_layers: int = 2,
+    num_experts: int = 4,
+    expert_hidden: int | None = None,
+    k: int = 2,
+    capacity_factor: float = 1.5,
+    aux_weight: float = 1e-2,
+    dropout: float = 0.1,
+    lr: float = 1e-3,
+    seed: int = 0,
+):
+    """Transformer encoder classifier with MoE FFN blocks (Switch-style).
+
+    Same task shape as
+    :func:`~elephas_tpu.models.transformer.transformer_classifier`; the
+    dense MLP in each block is replaced by ``num_experts`` routed experts
+    with a load-balance auxiliary loss.
+    """
+    import keras
+
+    from elephas_tpu.models.transformer import _flash_mha_layer, _positions
+
+    keras.utils.set_random_seed(seed)
+    L = keras.layers
+    FlashMHA = _flash_mha_layer()
+    MoeFFN = _moe_ffn_layer()
+    head_dim = d_model // num_heads
+    expert_hidden = expert_hidden or 4 * d_model
+
+    inputs = keras.Input((maxlen,), dtype="int32")
+    x = L.Embedding(vocab_size, d_model, name="tok_embed")(inputs)
+    x = x + _positions(maxlen, d_model)[None]
+    for b in range(num_layers):
+        name = f"blk{b}"
+        h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln1")(x)
+        h = FlashMHA(num_heads, head_dim, name=f"{name}_attn")(h)
+        h = L.Dropout(dropout, name=f"{name}_drop1")(h)
+        x = L.Add(name=f"{name}_res1")([x, h])
+        h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln2")(x)
+        h = MoeFFN(
+            num_experts,
+            expert_hidden,
+            k=k,
+            capacity_factor=capacity_factor,
+            aux_weight=aux_weight,
+            name=f"{name}_moe",
+        )(h)
+        h = L.Dropout(dropout, name=f"{name}_drop2")(h)
+        x = L.Add(name=f"{name}_res2")([x, h])
+    x = L.LayerNormalization(epsilon=1e-6, name="final_ln")(x)
+    x = L.GlobalAveragePooling1D(name="pool")(x)
+    activation = "sigmoid" if num_classes == 1 else "softmax"
+    outputs = L.Dense(num_classes, activation=activation, name="head")(x)
+    model = keras.Model(inputs, outputs, name="switch_transformer_classifier")
+    loss = (
+        "binary_crossentropy"
+        if num_classes == 1
+        else "sparse_categorical_crossentropy"
+    )
+    model.compile(
+        optimizer=keras.optimizers.Adam(lr), loss=loss, metrics=["accuracy"]
+    )
+    return model

@@ -60,6 +60,10 @@ DEFAULT_RULES: list[tuple[str, callable]] = [
     (r"(proj|mlp2)/kernel$", lambda m: P(m, None)),
     (r"embedding.*/embeddings$|tok_embed.*/embeddings$", lambda m: P(None, m)),
     (r"dense[^/]*/kernel$", lambda m: P(None, m)),
+    # MoeFFN expert weights [E, ...] shard over experts — GSPMD places
+    # the token all-to-all, i.e. expert parallelism on the model axis
+    (r"/expert_w[12]$", lambda m: P(m, None, None)),
+    (r"/expert_b[12]$", lambda m: P(m, None)),
     (r"/kernel$", lambda m: P(None, m)),
 ]
 
@@ -289,15 +293,16 @@ class ShardedTrainer(KerasIntrospection):
     # -- compiled train step -------------------------------------------
 
     def _loss_fn(self):
-        model = self.model
-
         def loss_fn(tv, ntv, x, y, sw):
-            y_pred, ntv2 = model.stateless_call(tv, ntv, x, training=True)
-            loss = model.compute_loss(x=x, y=y, y_pred=y_pred, sample_weight=sw)
-            # keras's sum_over_batch_size reduction divides by the full
-            # (padded) batch; rescale so a masked tail batch means exactly
-            # "mean over the valid rows"
-            loss = loss * (sw.size / jnp.maximum(jnp.sum(sw), 1.0))
+            y_pred, ntv2, total, extras = self._stateless_loss(
+                tv, ntv, x, y, sample_weight=sw
+            )
+            # The padded-batch rescale must apply to the data part only:
+            # peel the add_loss/regularizer extras off, rescale (keras's
+            # sum_over_batch_size divides by the full padded batch; we
+            # want "mean over valid rows"), then re-add them unscaled.
+            data_loss = total - extras
+            loss = data_loss * (sw.size / jnp.maximum(jnp.sum(sw), 1.0)) + extras
             return loss, (ntv2, y_pred)
 
         return loss_fn

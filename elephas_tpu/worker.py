@@ -273,6 +273,39 @@ class KerasIntrospection:
             else:
                 history.setdefault(name, []).append(float(np.asarray(res)))
 
+    def _stateless_loss(self, tv, ntv, x, y, sample_weight=None):
+        """Forward pass + total training loss with differentiable
+        add_loss/regularizer contributions.
+
+        ``stateless_call(return_losses=True)`` collects add_loss values
+        AND regularization losses computed from the TRACED variables;
+        ``compute_loss`` must read those via ``_losses_override`` —
+        keras's own jax train_step pattern. Calling ``compute_loss``
+        bare would fold in regularizers recomputed from concrete
+        variable state: right value, zero gradient.
+
+        Returns ``(y_pred, ntv2, total_loss, extras_sum)`` where
+        ``extras_sum`` is the (differentiable) sum of the add_loss /
+        regularizer terms inside ``total_loss``.
+        """
+        model = self.model
+        y_pred, ntv2, losses = model.stateless_call(
+            tv, ntv, x, training=True, return_losses=True
+        )
+        extras = sum(losses) if losses else 0.0
+        if losses:
+            model._losses_override.clear()
+            model._losses_override = list(losses)
+        try:
+            kwargs = {}
+            if sample_weight is not None:
+                kwargs["sample_weight"] = sample_weight
+            total = model.compute_loss(x=x, y=y, y_pred=y_pred, **kwargs)
+        finally:
+            if losses:
+                model._losses_override.clear()
+        return y_pred, ntv2, total, extras
+
 
 class MeshRunner(KerasIntrospection):
     """Owns the compiled train/eval/predict programs for one Keras model.
@@ -376,8 +409,7 @@ class MeshRunner(KerasIntrospection):
     # -- loss helpers --------------------------------------------------
 
     def _loss_and_updates(self, tv, ntv, x, y):
-        y_pred, ntv2 = self.model.stateless_call(tv, ntv, x, training=True)
-        loss = self.model.compute_loss(x=x, y=y, y_pred=y_pred)
+        y_pred, ntv2, loss, _extras = self._stateless_loss(tv, ntv, x, y)
         return loss, (ntv2, y_pred)
 
     # -- training ------------------------------------------------------

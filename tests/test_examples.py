@@ -39,6 +39,14 @@ def test_hyperparam_optimization():
     run_example("hyperparam_optimization", ["--max-evals", "3", "--epochs", "1"])
 
 
+def test_switch_moe_transformer():
+    run_example(
+        "switch_moe_transformer",
+        ["--epochs", "2", "--maxlen", "16", "--vocab", "100",
+         "--model-parallel", "2"],
+    )
+
+
 @pytest.mark.slow
 def test_imdb_lstm():
     run_example("imdb_lstm", ["--epochs", "1", "--maxlen", "20", "--vocab", "200"])
