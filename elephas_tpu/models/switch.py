@@ -19,8 +19,6 @@ parallelism through the same layer.
 
 from __future__ import annotations
 
-import numpy as np
-
 _MOE_FFN_CLS = None
 
 
@@ -57,6 +55,10 @@ def _moe_ffn_layer():
             **kwargs,
         ):
             super().__init__(**kwargs)
+            if k > num_experts:
+                raise ValueError(
+                    f"k={k} routing choices exceed num_experts={num_experts}"
+                )
             self.num_experts = num_experts
             self.d_hidden = d_hidden
             self.k = k

@@ -43,6 +43,10 @@ def _topk_dispatch(x, gate_w, num_experts: int, capacity: int, k: int = 1):
     Tokens beyond an expert's capacity are dropped (output zero — the
     residual connection around the MoE layer carries them, as in Switch).
     """
+    if k > num_experts:
+        raise ValueError(
+            f"k={k} routing choices exceed num_experts={num_experts}"
+        )
     logits = x @ gate_w  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
 

@@ -1,36 +1,57 @@
 """Pipeline parallelism — GPipe-style SPMD pipeline over a mesh axis.
 
 Absent from the reference (SURVEY.md §2a); provided as the TPU-native
-construction used for stacks of identical blocks (the realistic PP case:
-a transformer's repeated layers). Stage parameters are sharded over a
-``('stages',)`` mesh axis — device ``s`` holds stage ``s``'s weights —
-and microbatches flow through the ring: each tick every device applies
-its stage to its current activation and hands the result to the next
-device via ``lax.ppermute`` (one neighbor hop on ICI). With ``M``
-microbatches and ``S`` stages the schedule runs ``M + S − 1`` ticks;
-the ``(S−1)/M`` bubble fraction is the standard GPipe cost, amortized by
-more microbatches.
+construction. Stage parameters are sharded over a ``('stages',)`` mesh
+axis — device ``s`` holds stage ``s``'s weights — and microbatches flow
+through the ring: each tick every device applies its stage to its
+current activation and hands the result to the next device via
+``lax.ppermute`` (one neighbor hop on ICI). With ``M`` microbatches and
+``S`` stages the schedule runs ``M + S − 1`` ticks; the ``(S−1)/M``
+bubble fraction is the standard GPipe cost, amortized by more
+microbatches.
 
-The whole schedule is a ``lax.scan`` inside ``shard_map`` — one compiled
-program, differentiable end-to-end (the backward pass pipelines in
-reverse through the transposed ``ppermute``s automatically).
+Two surfaces:
+
+- :func:`gpipe` / :func:`gpipe_sharded` — the homogeneous-stack
+  primitive (identical stage shapes: a transformer's repeated blocks).
+  One ``lax.scan`` inside ``shard_map``, differentiable end-to-end (the
+  backward pass pipelines in reverse through the transposed
+  ``ppermute``\\ s automatically). Outputs stay on the last stage and
+  are sliced out per-stage-sharded — no whole-activation broadcast.
+- :class:`GPipeTrainer` — a *training loop* over heterogeneous stages:
+  per-stage activation shapes may all differ (activations ride a flat
+  padded buffer; ``lax.switch`` picks the device's stage, so shapes
+  stay static), the last stage computes the microbatch loss, gradients
+  accumulate across microbatches inside one backward pipeline, and an
+  optax optimizer updates the stage-sharded flat parameters in place —
+  weights, grads, and optimizer slots all live ``P('stages')``-sharded;
+  only neighbor activations cross the ICI ring.
 """
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 
 def gpipe(stage_fn, stage_params, x_microbatches, axis_name: str):
     """Run microbatches through the stage pipeline; call INSIDE shard_map.
 
     ``stage_fn(params, x) -> y`` applies one stage (same signature and
-    shapes for every stage; ``y.shape == x.shape``). ``stage_params`` is
-    this device's stage's params (the caller shards a stacked-[S, ...]
-    pytree over ``axis_name`` and passes the unstacked slice).
-    ``x_microbatches``: ``[M, mb, ...]`` (replicated — only stage 0 reads
-    it). Returns ``[M, mb, ...]`` outputs, replicated to all stages.
+    shapes for every stage; ``y.shape == x.shape`` — heterogeneous
+    stages go through :class:`GPipeTrainer`). ``stage_params`` is this
+    device's stage's params (the caller shards a stacked-[S, ...] pytree
+    over ``axis_name`` and passes the unstacked slice).
+    ``x_microbatches``: ``[M, mb, ...]`` (replicated — only stage 0
+    reads it). Returns ``[M, mb, ...]`` outputs, VALID ON THE LAST STAGE
+    ONLY (zeros elsewhere) — the caller slices the last stage's shard
+    out instead of paying an all-reduce broadcast of whole activations.
     """
     s = jax.lax.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
@@ -56,9 +77,7 @@ def gpipe(stage_fn, stage_params, x_microbatches, axis_name: str):
     (recv, outputs), _ = jax.lax.scan(
         one_tick, (recv0, out0), jnp.arange(ticks)
     )
-    # results live on the last stage; replicate them to every stage
-    outputs = jnp.where(stage == s - 1, outputs, jnp.zeros_like(outputs))
-    return jax.lax.psum(outputs, axis_name)
+    return outputs
 
 
 def gpipe_sharded(
@@ -71,26 +90,342 @@ def gpipe_sharded(
 ):
     """Global-array wrapper: shards stacked ``[S, ...]`` stage params over
     ``mesh[axis_name]``, splits ``x [B, ...]`` into microbatches, runs
-    :func:`gpipe`, and returns ``[B, ...]`` outputs."""
-    from jax.sharding import PartitionSpec as P
-
+    :func:`gpipe`, and returns ``[B, ...]`` outputs (read from the last
+    stage's shard — no cross-stage activation broadcast)."""
     b = x.shape[0]
     if b % num_microbatches:
         raise ValueError(
             f"batch {b} must divide into {num_microbatches} microbatches"
         )
+    s = mesh.shape[axis_name]
     xm = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
 
     def fn(params_slice, xm):
         params = jax.tree.map(lambda a: a[0], params_slice)
-        return gpipe(stage_fn, params, xm, axis_name)
+        out = gpipe(stage_fn, params, xm, axis_name)
+        return out[None]  # leading per-stage axis
 
     sharded = jax.shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(axis_name), P()),
-        out_specs=P(),
+        out_specs=P(axis_name),
         check_vma=False,
     )
-    out = sharded(stacked_params, xm)
+    out = sharded(stacked_params, xm)[s - 1]
     return out.reshape((b,) + out.shape[2:])
+
+
+class GPipeTrainer:
+    """Microbatched pipeline-parallel trainer over heterogeneous stages.
+
+    ``stage_fns``: list of ``fn(params, x) -> y`` — activation shapes may
+    differ at every boundary. ``stage_params``: list of per-stage pytrees.
+    ``loss_fn(y_pred, y) -> scalar`` (mean over the microbatch).
+
+    TPU mapping: stage ``s``'s parameters are flattened
+    (``ravel_pytree``), padded to the widest stage, and stacked
+    ``[S, P_max]`` sharded over the ``('stages',)`` axis — so are the
+    optimizer's moment slots. Activations cross stages as flat padded
+    buffers through ``lax.ppermute``; ``lax.switch`` selects each
+    device's stage so every reshape is static. One jitted train step
+    runs the full forward pipeline, a reversed backward pipeline
+    (gradient accumulation over microbatches for free via the scan
+    transpose), and the optax update.
+    """
+
+    def __init__(
+        self,
+        stage_fns,
+        stage_params,
+        loss_fn,
+        optimizer=None,
+        mesh: Mesh | None = None,
+        num_microbatches: int = 4,
+        axis_name: str = "stages",
+    ):
+        import optax
+        from jax.flatten_util import ravel_pytree
+
+        self.stage_fns = list(stage_fns)
+        self.loss_fn = loss_fn
+        self.S = len(self.stage_fns)
+        if self.S < 2:
+            raise ValueError("a pipeline needs at least 2 stages")
+        if len(stage_params) != self.S:
+            raise ValueError(
+                f"{len(stage_params)} param trees for {self.S} stages"
+            )
+        self.M = int(num_microbatches)
+        self.axis = axis_name
+        if mesh is None:
+            devices = jax.devices()
+            if len(devices) < self.S:
+                raise ValueError(
+                    f"{self.S} stages need {self.S} devices, have {len(devices)}"
+                )
+            mesh = Mesh(np.array(devices[: self.S]), (axis_name,))
+        if mesh.shape[axis_name] != self.S:
+            raise ValueError(
+                f"mesh axis {axis_name!r} has size {mesh.shape[axis_name]}, "
+                f"need {self.S} (one device per stage)"
+            )
+        self.mesh = mesh
+        self.optimizer = optimizer or optax.adam(1e-2)
+
+        flats, self._unravels = zip(
+            *[ravel_pytree(p) for p in stage_params]
+        )
+        self._p_sizes = [int(f.size) for f in flats]
+        self.P_max = max(self._p_sizes)
+        stacked = np.stack(
+            [
+                np.pad(np.asarray(f, np.float32), (0, self.P_max - f.size))
+                for f in flats
+            ]
+        )
+        self._stage_sh = NamedSharding(mesh, P(axis_name))
+        self._rep_sh = NamedSharding(mesh, P())
+        self.params = jax.device_put(stacked, self._stage_sh)
+        # optimizer slots mirror the stacked layout; scalar counters
+        # replicate
+        state_struct = jax.eval_shape(self.optimizer.init, self.params)
+        state_sh = jax.tree.map(
+            lambda s_: self._stage_sh if s_.shape[:1] == (self.S,) else self._rep_sh,
+            state_struct,
+        )
+        self.opt_state = jax.jit(
+            self.optimizer.init, out_shardings=state_sh
+        )(self.params)
+        self._shapes = None  # boundary ShapeDtypeStructs, set at first fit
+        self._train_step = None
+        self._predict_fn = None
+
+    # -- shape plumbing --------------------------------------------------
+
+    def _infer_shapes(self, mb_example):
+        """Chain eval_shape through the stages → S+1 boundary shapes."""
+        shapes = [jax.eval_shape(lambda a: a, mb_example)]
+        for s in range(self.S):
+            params_struct = jax.eval_shape(
+                self._unravels[s],
+                jax.ShapeDtypeStruct((self._p_sizes[s],), jnp.float32),
+            )
+            shapes.append(
+                jax.eval_shape(self.stage_fns[s], params_struct, shapes[-1])
+            )
+        self._shapes = shapes
+        self._elems = [int(np.prod(s.shape)) for s in shapes]
+        # the ring only carries boundaries 1..S (stage 0 reads the typed
+        # microbatch directly — int token ids never round-trip float32)
+        self.B_max = max(self._elems[1:])
+        self.mb_rows = int(shapes[0].shape[0])
+
+    def _branches(self):
+        """Per-stage flat-buffer transforms with static shapes. Each
+        branch gets ``(p, buf, xm_mb)``; stage 0 reads the typed
+        microbatch ``xm_mb``, later stages the flat ring buffer."""
+        branches = []
+        for s in range(self.S):
+            in_shape = self._shapes[s].shape
+            in_elems = self._elems[s]
+            out_pad = self.B_max - self._elems[s + 1]
+            fn = self.stage_fns[s]
+            unravel = self._unravels[s]
+            p_size = self._p_sizes[s]
+            first = s == 0
+
+            def branch(p, buf, xm_mb, fn=fn, unravel=unravel, p_size=p_size,
+                       in_shape=in_shape, in_elems=in_elems, out_pad=out_pad,
+                       first=first):
+                x = xm_mb if first else buf[:in_elems].reshape(in_shape)
+                out = fn(unravel(p[:p_size]), x)
+                flat = out.reshape(-1).astype(jnp.float32)
+                return jnp.pad(flat, (0, out_pad))
+
+            branches.append(branch)
+        return branches
+
+    # -- forward/loss ----------------------------------------------------
+
+    def _forward(self, collect_outputs: bool, with_loss: bool = True):
+        """Build the shard_map'd pipeline program.
+
+        Returns ``fn(params, xm, ym) -> (loss, outputs?)`` with ``xm
+        [M, mb, ...]`` microbatches (replicated, original dtype — only
+        stage 0 reads them) and ``ym [M, ...]`` targets (replicated;
+        only the last stage reads them, and only when ``with_loss``).
+        ``loss`` comes back replicated (scalar psum); outputs, if
+        collected, come back per-stage-sharded ``[S, M, out_elems]`` —
+        the caller reads shard ``S-1``.
+        """
+        S, M, axis = self.S, self.M, self.axis
+        branches = self._branches()
+        out_elems = self._elems[-1]
+        out_shape = self._shapes[-1].shape
+        loss_fn = self.loss_fn
+
+        def per_device(pflat, xm, ym):
+            p = pflat[0]
+            stage = jax.lax.axis_index(axis)
+            is_last = stage == S - 1
+            ticks = M + S - 1
+
+            def one_tick(carry, t):
+                recv, outputs, loss_sum = carry
+                mb_idx = jnp.clip(t, 0, M - 1)
+                out = jax.lax.switch(
+                    stage,
+                    [lambda b, xmb, br=br: br(p, b, xmb) for br in branches],
+                    recv,
+                    xm[mb_idx],
+                )
+                write_idx = t - (S - 1)
+                is_valid = is_last & (write_idx >= 0)
+                widx = jnp.clip(write_idx, 0, M - 1)
+                if with_loss:
+                    # sanitize before the loss: non-last stages feed zeros
+                    # so the untaken where-branch cannot generate NaNs
+                    # that leak through the gradient of where()
+                    y_pred = jnp.where(
+                        is_valid, out[:out_elems], jnp.zeros((out_elems,))
+                    ).reshape(out_shape)
+                    mb_loss = loss_fn(y_pred, ym[widx])
+                    loss_sum = loss_sum + jnp.where(is_valid, mb_loss, 0.0)
+                if collect_outputs:
+                    updated = outputs.at[widx].set(out[:out_elems])
+                    outputs = jnp.where(is_valid, updated, outputs)
+                recv = jax.lax.ppermute(
+                    out, axis, [(i, (i + 1) % S) for i in range(S)]
+                )
+                return (recv, outputs, loss_sum), None
+
+            recv0 = jnp.zeros((self.B_max,), jnp.float32)
+            outputs0 = jnp.zeros((M, out_elems), jnp.float32)
+            (recv, outputs, loss_sum), _ = jax.lax.scan(
+                one_tick, (recv0, outputs0, jnp.float32(0.0)), jnp.arange(ticks)
+            )
+            loss = jax.lax.psum(loss_sum, axis) / M
+            return loss, outputs[None]
+
+        return jax.shard_map(
+            per_device,
+            mesh=self.mesh,
+            in_specs=(P(self.axis), P(), P()),
+            out_specs=(P(), P(self.axis)),
+            check_vma=False,
+        )
+
+    def _build_train_step(self):
+        forward = self._forward(collect_outputs=False)
+        optimizer = self.optimizer
+
+        def loss_of(params, xm, ym):
+            loss, _ = forward(params, xm, ym)
+            return loss
+
+        def step(params, opt_state, xm, ym):
+            loss, grads = jax.value_and_grad(loss_of)(params, xm, ym)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            import optax
+
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        state_sh = jax.tree.map(lambda l: l.sharding, self.opt_state)
+        return jax.jit(
+            step,
+            in_shardings=(self._stage_sh, state_sh, self._rep_sh, self._rep_sh),
+            out_shardings=(self._stage_sh, state_sh, self._rep_sh),
+            donate_argnums=(0, 1),
+        )
+
+    # -- data shaping ----------------------------------------------------
+
+    def _microbatches(self, x, n_rows):
+        """[B, ...] → [M, mb, ...] in the input's own dtype (stage 0
+        consumes this directly — integer token ids stay integer)."""
+        mb = n_rows // self.M
+        return np.asarray(x).reshape((self.M, mb) + x.shape[1:])
+
+    # -- API -------------------------------------------------------------
+
+    def fit(self, x, y, epochs: int = 1, batch_size: int = 32, verbose: int = 0):
+        """Mini-batch training; returns ``{'loss': [...]}`` per epoch.
+
+        ``batch_size`` is rounded up to a multiple of ``M`` (each
+        microbatch keeps a fixed shape); the final short batch wrap-pads
+        rows at full weight — duplicated rows slightly overweight, the
+        same semantics as the DP runner's staged
+        :func:`~elephas_tpu.worker.pad_to_batches` (the masked-tail
+        exactness of :class:`~elephas_tpu.parallel.tensor.ShardedTrainer`
+        would need weight-aware user loss_fns here).
+        """
+        x = np.asarray(x)
+        y = np.asarray(y)
+        n = len(x)
+        M = self.M
+        batch_size = max(M, (batch_size // M) * M)
+        if self._shapes is None:
+            mb_x = jnp.zeros((batch_size // M,) + x.shape[1:], x.dtype)
+            self._infer_shapes(mb_x)
+        # the compiled pipeline is specialized to one microbatch shape
+        batch_size = self.M * self.mb_rows
+        nb = max(1, int(np.ceil(n / batch_size)))
+        idx = np.arange(nb * batch_size) % n
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+
+        history = {"loss": []}
+        for epoch in range(epochs):
+            losses = []
+            for b in range(nb):
+                rows = idx[b * batch_size : (b + 1) * batch_size]
+                xm = self._microbatches(x[rows], batch_size)
+                ym = np.asarray(y[rows]).reshape(
+                    (M, batch_size // M) + y.shape[1:]
+                )
+                self.params, self.opt_state, loss = self._train_step(
+                    self.params, self.opt_state, xm, ym
+                )
+                losses.append(loss)
+            epoch_loss = float(np.mean([np.asarray(l) for l in losses]))
+            history["loss"].append(epoch_loss)
+            if verbose:
+                logger.info(
+                    "epoch %d/%d - loss %.4f", epoch + 1, epochs, epoch_loss
+                )
+        return history
+
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        x = np.asarray(x)
+        n = len(x)
+        M = self.M
+        batch_size = max(M, (batch_size // M) * M)
+        if self._shapes is None:
+            mb_x = jnp.zeros((batch_size // M,) + x.shape[1:], x.dtype)
+            self._infer_shapes(mb_x)
+        batch_size = self.M * self.mb_rows  # fixed microbatch shape
+        if self._predict_fn is None:
+            forward = self._forward(collect_outputs=True, with_loss=False)
+            self._predict_fn = jax.jit(
+                lambda p, xm, ym: forward(p, xm, ym)[1],
+                in_shardings=(self._stage_sh, self._rep_sh, self._rep_sh),
+                out_shardings=NamedSharding(self.mesh, P(self.axis)),
+            )
+        out_shape = self._shapes[-1].shape
+        nb = max(1, int(np.ceil(n / batch_size)))
+        idx = np.arange(nb * batch_size) % n
+        ym0 = np.zeros((M, 1), np.float32)  # targets unused without loss
+        outs = []
+        for b in range(nb):
+            rows = idx[b * batch_size : (b + 1) * batch_size]
+            xm = self._microbatches(x[rows], batch_size)
+            res = np.asarray(self._predict_fn(self.params, xm, ym0))
+            outs.append(res[self.S - 1].reshape((batch_size,) + out_shape[1:]))
+        return np.concatenate(outs)[:n]
+
+    def stage_weights(self, s: int):
+        """Stage ``s``'s parameter pytree (host copy, unflattened)."""
+        flat = np.asarray(self.params[s])[: self._p_sizes[s]]
+        return self._unravels[s](jnp.asarray(flat))

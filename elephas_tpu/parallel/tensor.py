@@ -575,9 +575,15 @@ class ShardedTrainer(KerasIntrospection):
         multi = len(self._output_names()) > 1
 
         def eval_step(tv, ntv, mvs, sums, wsum, x, y, w):
-            y_pred, _ = model.stateless_call(tv, ntv, x, training=False)
+            # return_losses: add_loss/regularizer penalties belong in the
+            # reported total loss, as in keras's test_step
+            y_pred, _, extra_losses = model.stateless_call(
+                tv, ntv, x, training=False, return_losses=True
+            )
+            extras = sum(extra_losses) if extra_losses else 0.0
             values = per_sample_loss(y, y_pred)
             sums = {k: sums[k] + jnp.sum(values[k] * w) for k in loss_keys}
+            sums = dict(sums, loss=sums["loss"] + extras * jnp.sum(w))
             wsum = wsum + jnp.sum(w)
             mvs2 = []
             for (m, i, _n), mv in zip(metric_objects, mvs):

@@ -652,11 +652,21 @@ class MeshRunner(KerasIntrospection):
             def step(carry, batch):
                 loss_sums, weight_sum, mvs = carry
                 x, y, w = batch
-                y_pred, _ = model.stateless_call(tv, ntv, x, training=False)
+                # return_losses: add_loss/regularizer penalties belong in
+                # the reported total loss, as in keras's test_step
+                y_pred, _, extra_losses = model.stateless_call(
+                    tv, ntv, x, training=False, return_losses=True
+                )
+                extras = sum(extra_losses) if extra_losses else 0.0
                 values = per_sample_loss(y, y_pred)
                 loss_sums = {
                     k: loss_sums[k] + jnp.sum(values[k] * w) for k in loss_keys
                 }
+                # weight-scaled so the final divide leaves the penalty
+                # un-normalized (it is per-model, not per-sample)
+                loss_sums = dict(
+                    loss_sums, loss=loss_sums["loss"] + extras * jnp.sum(w)
+                )
                 weight_sum = weight_sum + jnp.sum(w)
                 new_mvs = []
                 for (m, i, _name), mv in zip(metric_objects, mvs):

@@ -274,3 +274,15 @@ def test_moe_layer_shards_experts_under_tp():
     )
     history = sm.fit((x, y), epochs=2, batch_size=32)
     assert np.isfinite(history["loss"]).all()
+
+
+def test_topk_rejects_k_above_num_experts():
+    from elephas_tpu.ops.moe import _topk_dispatch
+    from elephas_tpu.models.switch import MoeFFN
+
+    x = jnp.ones((8, 4))
+    gate_w = jnp.ones((4, 2))
+    with pytest.raises(ValueError, match="exceed"):
+        _topk_dispatch(x, gate_w, 2, capacity=8, k=3)
+    with pytest.raises(ValueError, match="exceed"):
+        MoeFFN(2, 16, k=4)

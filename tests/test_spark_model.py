@@ -295,3 +295,59 @@ def test_dict_loss_evaluates(spark_context, blobs):
     spark_model = SparkModel(model, num_workers=8)
     dist = spark_model.evaluate(x, [y, y_reg], batch_size=64)
     np.testing.assert_allclose(dist[0], ref["loss"], rtol=1e-4)
+
+
+def test_evaluate_includes_add_loss_penalties(blobs):
+    """code-review r3: evaluate's reported loss must include
+    add_loss/regularizer penalties like keras's test_step — train loss
+    and val loss stay comparable."""
+    import keras
+
+    x, y, d, k = blobs
+    keras.utils.set_random_seed(43)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((d,)),
+            keras.layers.Dense(
+                32, activation="relu",
+                kernel_regularizer=keras.regularizers.L2(0.1),
+            ),
+            keras.layers.Dense(k, activation="softmax"),
+        ]
+    )
+    model.compile(
+        optimizer="adam", loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    sm = SparkModel(model, num_workers=8)
+    dist = sm.evaluate(x[:301], y[:301], batch_size=32)
+    ref = model.evaluate(x[:301], y[:301], verbose=0)
+    assert abs(dist[0] - ref[0]) < 1e-3, (dist, ref)
+    assert abs(dist[1] - ref[1]) < 1e-6
+
+
+def test_tp_evaluate_includes_add_loss_penalties(blobs):
+    import keras
+
+    from elephas_tpu.parallel.tensor import ShardedTrainer
+
+    x, y, d, k = blobs
+    keras.utils.set_random_seed(44)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((d,)),
+            keras.layers.Dense(
+                32, activation="relu",
+                kernel_regularizer=keras.regularizers.L2(0.1),
+            ),
+            keras.layers.Dense(k, activation="softmax"),
+        ]
+    )
+    model.compile(
+        optimizer="adam", loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    trainer = ShardedTrainer(model, model_parallel=2)
+    results = trainer.evaluate(x[:301], y[:301], batch_size=32)
+    ref = model.evaluate(x[:301], y[:301], verbose=0)
+    assert abs(results["loss"] - ref[0]) < 1e-3, (results, ref)
