@@ -8,8 +8,14 @@ maps partitions onto TPU mesh workers and stacks them into device arrays.
 Only the API surface the reference exercises is implemented:
 ``mapPartitions``, ``map``, ``filter``, ``collect``, ``repartition``,
 ``getNumPartitions``, ``count``, ``first``, ``take``, ``cache``,
-``unpersist``, ``zip``. Everything is eager (no DAG) — laziness buys
-nothing when the compute path is XLA.
+``unpersist``, ``zip``. Transformations are eager (no DAG) — laziness
+buys nothing when the compute path is XLA — with ONE exception:
+:class:`LazyRows` partitions are contiguous row-range *views* of
+sliceable backing stores (memmap, h5py), the analogue of the reference's
+cluster-resident RDD whose partitions never all live on one host.
+``SparkModel.fit`` streams those block-by-block
+(:mod:`elephas_tpu.data.streaming`); any eager transformation (``map``,
+``collect``, ``repartition``) materializes them.
 """
 
 from __future__ import annotations
@@ -18,9 +24,41 @@ import itertools
 from typing import Any, Callable, Iterable, Iterator
 
 
+class LazyRows:
+    """A partition holding rows ``[lo, hi)`` of sliceable row-aligned
+    ``(x, y)`` sources, materialized only on iteration."""
+
+    __slots__ = ("x", "y", "lo", "hi")
+
+    def __init__(self, x, y, lo: int, hi: int):
+        if not 0 <= lo <= hi:
+            raise ValueError(f"bad row range [{lo}, {hi})")
+        self.x, self.y, self.lo, self.hi = x, y, lo, hi
+
+    def __len__(self) -> int:
+        return self.hi - self.lo
+
+    def __iter__(self):
+        import numpy as np
+
+        for i in range(self.lo, self.hi):
+            yield (np.asarray(self.x[i]), np.asarray(self.y[i]))
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+
 class Rdd:
-    def __init__(self, partitions: list[list[Any]]):
-        self._partitions = [list(p) for p in partitions]
+    def __init__(self, partitions: list):
+        self._partitions = [
+            p if isinstance(p, LazyRows) else list(p) for p in partitions
+        ]
+
+    def is_lazy(self) -> bool:
+        """True when every partition is a lazy row-range view."""
+        return bool(self._partitions) and all(
+            isinstance(p, LazyRows) for p in self._partitions
+        )
 
     # -- structure -----------------------------------------------------
 

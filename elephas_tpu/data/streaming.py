@@ -101,16 +101,29 @@ class ShardedStream:
             (step_hi - step_lo, self.batch_size) + rows.shape[1:]
         )
 
-    def blocks(self) -> Iterator[tuple[np.ndarray, np.ndarray, int]]:
-        """Yields ``(x_block [W, s, B, ...], y_block, steps_in_block)``."""
+    def blocks(
+        self, worker_indices: list[int] | None = None
+    ) -> Iterator[tuple[np.ndarray, np.ndarray, int]]:
+        """Yields ``(x_block [W', s, B, ...], y_block, steps_in_block)``.
+
+        ``worker_indices`` restricts the gather to those workers' rows
+        (``W' = len(worker_indices)``) — on a multi-host gang each
+        process gathers ONLY its addressable workers' rows from the
+        backing store instead of the whole ``[W, ...]`` block (which
+        would multiply storage bandwidth by the process count)."""
+        workers = (
+            list(range(self.num_workers))
+            if worker_indices is None
+            else list(worker_indices)
+        )
         for b in range(self.num_blocks):
             lo = b * self.block_steps
             hi = min(self.steps, lo + self.block_steps)
             xb = np.stack(
-                [self._gather_rows(self.x, w, lo, hi) for w in range(self.num_workers)]
+                [self._gather_rows(self.x, w, lo, hi) for w in workers]
             )
             yb = np.stack(
-                [self._gather_rows(self.y, w, lo, hi) for w in range(self.num_workers)]
+                [self._gather_rows(self.y, w, lo, hi) for w in workers]
             )
             yield xb, yb, hi - lo
 
@@ -119,6 +132,76 @@ class ShardedStream:
             np.asarray(self.x[0:1]).nbytes + np.asarray(self.y[0:1]).nbytes
         )
         return row * self.batch_size * self.block_steps * self.num_workers
+
+
+class ConcatRows:
+    """Sliceable concatenation of row-range views over backing stores —
+    the bridge from a lazy :class:`~elephas_tpu.data.rdd.Rdd` (partitions
+    as ``LazyRows``) to :class:`ShardedStream`'s flat row index space.
+
+    ``pieces``: list of ``(source, lo, hi)``. Supports ``len``, scalar
+    int, slice, and SORTED index-array ``__getitem__`` (all
+    ``ShardedStream`` uses) without ever materializing the whole range.
+    """
+
+    def __init__(self, pieces: list[tuple]):
+        if not pieces:
+            raise ValueError("no pieces")
+        self.pieces = [(src, int(lo), int(hi)) for src, lo, hi in pieces]
+        self.bounds = np.cumsum([0] + [hi - lo for _, lo, hi in self.pieces])
+        # array protocol (is_lazy_source contract) via a one-row probe
+        probe = np.asarray(self.pieces[0][0][self.pieces[0][1] : self.pieces[0][1] + 1])
+        self.ndim = probe.ndim
+        self.dtype = probe.dtype
+
+    def __len__(self) -> int:
+        return int(self.bounds[-1])
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            start, stop, step = idx.indices(len(self))
+            idx = np.arange(start, stop, step)
+        idx = np.asarray(idx)
+        if idx.ndim == 0:
+            p = int(np.searchsorted(self.bounds, idx, "right")) - 1
+            src, lo, _ = self.pieces[p]
+            return np.asarray(src[int(idx) - int(self.bounds[p]) + lo])
+        # sorted index arrays split into per-piece runs
+        out = []
+        splits = np.searchsorted(idx, self.bounds[1:-1], "left")
+        for p, grp in enumerate(np.split(idx, splits)):
+            if len(grp) == 0:
+                continue
+            src, lo, _ = self.pieces[p]
+            out.append(np.asarray(src[grp - int(self.bounds[p]) + lo]))
+        return np.concatenate(out)
+
+
+def lazy_rdd_sources(rdd) -> tuple[ConcatRows, ConcatRows]:
+    """(x, y) sliceable views over a lazy Rdd's partitions, in order."""
+    parts = rdd.partitions()
+    x = ConcatRows([(p.x, p.lo, p.hi) for p in parts])
+    y = ConcatRows([(p.y, p.lo, p.hi) for p in parts])
+    return x, y
+
+
+def is_lazy_source(a) -> bool:
+    """Positively detect out-of-core row stores (memmap, h5py, zarr —
+    array-likes with ``ndim``/``dtype`` and row ``__getitem__``).
+
+    Plain ndarrays are eager; lists/tuples lack the array protocol and
+    get ``np.asarray``'d by callers; pandas objects are excluded because
+    ``df[i]`` indexes COLUMNS — silently wrong as a row store."""
+    if type(a) is np.ndarray:
+        return False
+    if hasattr(a, "iloc"):
+        return False
+    return (
+        hasattr(a, "__getitem__")
+        and hasattr(a, "__len__")
+        and hasattr(a, "ndim")
+        and hasattr(a, "dtype")
+    )
 
 
 def estimate_nbytes(x, y) -> int:

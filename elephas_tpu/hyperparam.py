@@ -16,9 +16,10 @@ TPU framework should carry). The same capability is provided natively:
   builder ``params -> compiled keras.Model`` and ``data`` is either a
   tuple ``(x_train, y_train, x_val, y_val)`` or a zero-arg callable
   returning one;
-- trials are placed round-robin on the mesh devices (each trial trains
-  single-device via a 1-device mesh runner — architectures differ across
-  trials, so they cannot share one SPMD program the way one model's data
+- trials lease device groups from a pool (``devices_per_trial`` devices
+  each; default 1 maximizes concurrency, larger groups give each trial
+  in-trial data parallelism — architectures differ across trials, so
+  trials cannot share one SPMD program the way one model's data
   parallelism can).
 """
 
@@ -302,12 +303,19 @@ class HyperParamModel:
         batch_size: int = 32,
         verbose: int = 0,
         strategy: str = "adaptive",
+        devices_per_trial: int = 1,
     ):
         """Run ``max_evals`` trials; returns the best trained model.
 
         ``model(params)`` must return a *compiled* keras model;
         ``data`` is ``(x_train, y_train, x_val, y_val)`` or a callable
         producing it. Per-trial validation loss decides the winner.
+
+        ``devices_per_trial``: each trial trains data-parallel on a
+        group of that many local devices (big-model searches need the
+        mesh inside one trial; the default 1 maximizes trial
+        concurrency). Concurrency becomes
+        ``num_workers // devices_per_trial`` device groups.
         """
         import jax
         from jax.sharding import Mesh
@@ -317,6 +325,11 @@ class HyperParamModel:
         if strategy not in ("adaptive", "random"):
             raise ValueError(
                 f"strategy must be 'adaptive' or 'random', got {strategy!r}"
+            )
+        if devices_per_trial < 1 or devices_per_trial > self.num_workers:
+            raise ValueError(
+                f"devices_per_trial={devices_per_trial} must be in "
+                f"[1, {self.num_workers}]"
             )
         if callable(data):
             data = data()
@@ -339,19 +352,29 @@ class HyperParamModel:
         # layer-naming state is global) so only in-flight trials hold live
         # models — memory stays O(concurrency + 1 best), not O(max_evals).
         # Within a round, trials train/evaluate concurrently, one thread
-        # per local device, each on its own 1-device mesh.
+        # per device GROUP, each on its own devices_per_trial-device mesh.
         import queue
         import threading
 
         build_lock = threading.Lock()
         best_lock = threading.Lock()
         best_state: dict = {"loss": float("inf"), "model": None, "index": None}
-        # devices are leased from a free pool, not indexed by trial number —
-        # heterogeneous trial runtimes would otherwise double-book one
-        # device while its neighbor sits idle
+        # device GROUPS are leased from a free pool, not indexed by trial
+        # number — heterogeneous trial runtimes would otherwise
+        # double-book one group while its neighbor sits idle
+        n_groups = self.num_workers // devices_per_trial
+        leftover = self.num_workers - n_groups * devices_per_trial
+        if leftover:
+            logger.warning(
+                "devices_per_trial=%d does not divide %d workers; %d "
+                "device(s) will sit idle",
+                devices_per_trial, self.num_workers, leftover,
+            )
         free_devices: queue.Queue = queue.Queue()
-        for d in self.devices[: self.num_workers]:
-            free_devices.put(d)
+        for g in range(n_groups):
+            free_devices.put(
+                self.devices[g * devices_per_trial : (g + 1) * devices_per_trial]
+            )
 
         def run_trial(arg) -> Trial:
             i, params = arg
@@ -361,17 +384,19 @@ class HyperParamModel:
                 raise ValueError(
                     "model builder must return a compiled keras model"
                 )
-            device = free_devices.get()
+            group = free_devices.get()
             try:
-                return _train_on(device, i, params, trial_model)
+                return _train_on(group, i, params, trial_model)
             finally:
-                free_devices.put(device)
+                free_devices.put(group)
 
-        def _train_on(device, i: int, params: dict, trial_model) -> Trial:
-            mesh = Mesh(np.array([device]), ("workers",))
+        def _train_on(group, i: int, params: dict, trial_model) -> Trial:
+            mesh = Mesh(np.array(group), ("workers",))
             runner = MeshRunner(trial_model, "synchronous", "epoch", mesh)
             runner.run_epochs(
-                [(x_train, y_train)], epochs=epochs, batch_size=batch_size
+                runner._fit_partitions_to_mesh([(x_train, y_train)]),
+                epochs=epochs,
+                batch_size=batch_size,
             )
             results = runner.evaluate([(x_val, y_val)], batch_size=batch_size)
             trial = Trial(params=params, loss=results["loss"], metrics=results)
@@ -399,7 +424,7 @@ class HyperParamModel:
         completed: list[tuple[dict, float]] = []
         evals_done = 0
         while evals_done < max_evals:
-            global_batch = min(max_evals - evals_done, self.num_workers * n_proc)
+            global_batch = min(max_evals - evals_done, n_groups * n_proc)
             my_slots = list(range(pid, global_batch, n_proc))
             if sampler is not None:
                 batch_params = sampler.sample_batch(len(my_slots), completed)
@@ -412,7 +437,7 @@ class HyperParamModel:
                 (local_base + j, params)
                 for j, params in enumerate(batch_params)
             ]
-            with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            with ThreadPoolExecutor(max_workers=n_groups) as pool:
                 round_trials = list(pool.map(run_trial, indexed))
             self.trials.extend(round_trials)
             if n_proc > 1:
