@@ -122,6 +122,12 @@ HasFrequency = _mixin(
 HasNumberOfWorkers = _mixin(
     "num_workers", "Mesh workers (devices); None = all.", None, cap="NumberOfWorkers"
 )
+HasModelParallel = _mixin(
+    "model_parallel",
+    "Model-axis size of the ('data','model') mesh; 1 = data-parallel only.",
+    1,
+    cap="ModelParallel",
+)
 HasEpochs = _mixin("epochs", "Training epochs.", 10)
 HasBatchSize = _mixin("batch_size", "Per-worker batch size.", 32, cap="BatchSize")
 HasVerbosity = _mixin("verbose", "Verbosity 0/1/2.", 0, cap="Verbosity")

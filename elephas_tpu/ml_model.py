@@ -37,6 +37,7 @@ from elephas_tpu.ml.params import (
     HasLoss,
     HasMetrics,
     HasMode,
+    HasModelParallel,
     HasNumberOfClasses,
     HasNumberOfWorkers,
     HasOptimizerConfig,
@@ -54,6 +55,7 @@ class _ElephasParams(
     HasMode,
     HasFrequency,
     HasNumberOfWorkers,
+    HasModelParallel,
     HasEpochs,
     HasBatchSize,
     HasVerbosity,
@@ -127,6 +129,7 @@ class ElephasEstimator(_ElephasParams):
             num_workers=config["num_workers"],
             custom_objects=config["custom_objects"],
             batch_size=config["batch_size"],
+            model_parallel=config.get("model_parallel", 1),
         )
         spark_model.fit(
             rdd,

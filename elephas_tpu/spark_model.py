@@ -146,15 +146,11 @@ class SparkModel:
         cls = {"http": HttpServer, "socket": SocketServer}.get(
             self.parameter_server_mode
         )
-        if cls is None and self.parameter_server_mode == "native":
+        if cls is None:
+            # mode already validated in __init__; only 'native' remains
             from elephas_tpu.parameter.native import NativeParameterServer
 
             cls = NativeParameterServer
-        if cls is None:
-            raise ValueError(
-                f"parameter_server_mode must be 'http', 'socket', 'native' "
-                f"or None, got {self.parameter_server_mode!r}"
-            )
         self._parameter_server = cls(
             self._master_network.get_weights(), mode=self.mode, port=self.port
         )
@@ -227,10 +223,14 @@ class SparkModel:
                 steps_per_epoch=steps_per_epoch,
                 stream_block_steps=stream_block_steps,
             )
-        if rdd.is_lazy():
+        if rdd.is_lazy() and self.frequency != "fit":
             # partitions are row-range views of backing stores — stream
             # them instead of materializing (the cluster-resident-RDD
-            # property on the parity entry point; VERDICT r2 missing #6)
+            # property on the parity entry point; VERDICT r2 missing #6).
+            # frequency='fit' (train whole fit locally, average once)
+            # contradicts streaming, so lazy RDDs fall through to the
+            # eager path there — partition_arrays gathers each partition
+            # in one ranged read.
             from elephas_tpu.data.streaming import lazy_rdd_sources
 
             x, y = lazy_rdd_sources(rdd)
@@ -248,7 +248,10 @@ class SparkModel:
                 steps_per_epoch=steps_per_epoch,
                 stream_block_steps=stream_block_steps,
             )
-        if rdd.getNumPartitions() != self.num_workers:
+        if not rdd.is_lazy() and rdd.getNumPartitions() != self.num_workers:
+            # lazy RDDs skip the element-wise repartition (it would
+            # materialize row-by-row); the runner's partition shaping
+            # re-splits the ranged reads to the mesh instead
             rdd = rdd.repartition(self.num_workers)
         partitions = rdd_utils.partition_arrays(rdd)
         return self._fit_partitions(

@@ -115,14 +115,22 @@ def partition_arrays(rdd: Rdd) -> list[tuple[np.ndarray, np.ndarray]]:
     """Stack each partition of a simple RDD into ``(x[P,...], y[P,...])``.
 
     Empty partitions are dropped: the mesh runner pads worker loads, and a
-    zero-row partition carries no information.
+    zero-row partition carries no information. Lazy row-range partitions
+    materialize with ONE ranged read per partition (not a backing-store
+    read per row).
     """
+    from elephas_tpu.data.rdd import LazyRows
+
     out = []
     for part in rdd.partitions():
         if not part:
             continue
-        xs = np.stack([np.asarray(x) for x, _ in part])
-        ys = np.stack([np.asarray(y) for _, y in part])
+        if isinstance(part, LazyRows):
+            xs = np.asarray(part.x[part.lo : part.hi])
+            ys = np.asarray(part.y[part.lo : part.hi])
+        else:
+            xs = np.stack([np.asarray(x) for x, _ in part])
+            ys = np.stack([np.asarray(y) for _, y in part])
         out.append((xs, ys))
     if not out:
         raise ValueError("RDD has no data")

@@ -122,3 +122,70 @@ def test_ring_attention_gradients_match(causal):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4, err_msg=name
         )
+
+
+def test_ring_attention_long_context_training():
+    """r3: sequence parallelism is TRAINABLE end-to-end — a classifier
+    whose attention runs ring-sharded over 8 sequence shards has
+    gradients matching the dense-attention oracle, and adam training
+    through the ring drives the loss down. The task needs cross-shard
+    attention (label = which half of the sequence carries the marker),
+    so a shard-local model cannot solve it."""
+    import optax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    S, D, V, B = 128, 16, 32, 32  # 16 tokens per shard
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, size=B).astype(np.int32)
+    x = rng.integers(4, V, size=(B, S)).astype(np.int32)
+    # marker token 1 in the first half for class 0, second half for 1
+    pos = rng.integers(0, S // 2, size=B) + np.where(y == 1, S // 2, 0)
+    x[np.arange(B), pos] = 1
+
+    key = jax.random.PRNGKey(1)
+    ks = jax.random.split(key, 5)
+    params = {
+        "emb": jax.random.normal(ks[0], (V, D)) * 0.5,
+        "wq": jax.random.normal(ks[1], (D, D)) * D**-0.5,
+        "wk": jax.random.normal(ks[2], (D, D)) * D**-0.5,
+        "wv": jax.random.normal(ks[3], (D, D)) * D**-0.5,
+        "head": jax.random.normal(ks[4], (D, 2)) * 0.2,
+    }
+
+    def forward(params, xb, ring: bool):
+        h = params["emb"][xb]  # [B, S, D]
+        q, k, v = h @ params["wq"], h @ params["wk"], h @ params["wv"]
+        if ring:
+            att = ring_attention_sharded(q, k, v, mesh, axis_name="seq")
+        else:
+            att = attention_reference(q, k, v)
+        pooled = (att + h).mean(axis=1)
+        return pooled @ params["head"]
+
+    def loss_fn(params, xb, yb, ring):
+        logits = forward(params, xb, ring)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1))
+
+    g_ring = jax.grad(lambda p: loss_fn(p, x, y, True))(params)
+    g_dense = jax.grad(lambda p: loss_fn(p, x, y, False))(params)
+    for a, b in zip(jax.tree.leaves(g_ring), jax.tree.leaves(g_dense)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    opt = optax.adam(3e-2)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(p, x, y, True))(params)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    losses = []
+    for _ in range(40):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+    preds = np.asarray(forward(params, x, True)).argmax(-1)
+    assert (preds == y).mean() > 0.9

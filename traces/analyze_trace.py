@@ -1,0 +1,61 @@
+"""Summarize a bench.py --profile-dir trace: device-busy fraction and
+top kernels by self time.
+
+Usage: python traces/analyze_trace.py traces/resnet50_r3
+
+The busy fraction is the trace-backed half of the MFU story: if the
+device is ~always busy while MFU sits at ~26%, the gap to peak lives
+INSIDE the kernels (MXU under-utilization of the conv mix), not in
+dispatch, host work, or framework overhead.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import sys
+
+
+def main(trace_dir: str) -> None:
+    paths = glob.glob(f"{trace_dir}/plugins/profile/*/*.trace.json.gz")
+    if not paths:
+        raise SystemExit(f"no trace.json.gz under {trace_dir}")
+    data = json.load(gzip.open(sorted(paths)[-1]))
+    events = data.get("traceEvents", [])
+
+    pids = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e["args"].get("name", "")
+
+    # leaf kernels only: skip the module/while/step-number parent spans
+    def is_parent(name: str) -> bool:
+        return (
+            name.startswith("jit_")
+            or name.startswith("while")
+            or name.isdigit()
+        )
+
+    dur = collections.Counter()
+    lo, hi = float("inf"), 0.0
+    for e in events:
+        if e.get("ph") != "X" or "TPU" not in pids.get(e.get("pid"), ""):
+            continue
+        ts, d = e.get("ts", 0), e.get("dur", 0)
+        lo, hi = min(lo, ts), max(hi, ts + d)
+        if not is_parent(e.get("name", "")):
+            dur[e["name"]] += d
+
+    busy = sum(dur.values())
+    window = hi - lo
+    print(f"device window: {window/1e6:.3f}s   leaf-kernel busy: "
+          f"{busy/1e6:.3f}s   busy fraction: {busy/window*100:.1f}%")
+    print("top kernels by self time:")
+    for name, d in dur.most_common(15):
+        print(f"  {d/busy*100:5.1f}%  {name[:90]}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "traces/resnet50_r3")
