@@ -71,5 +71,21 @@ __all__ = [
     "load_ml_estimator",
     "load_ml_transformer",
     "HyperParamModel",
+    "ShardedTrainer",
+    "GPipeTrainer",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    # heavier TPU-native extensions resolve lazily so the parity surface
+    # stays import-light
+    if name == "ShardedTrainer":
+        from elephas_tpu.parallel.tensor import ShardedTrainer
+
+        return ShardedTrainer
+    if name == "GPipeTrainer":
+        from elephas_tpu.ops.pipeline import GPipeTrainer
+
+        return GPipeTrainer
+    raise AttributeError(name)

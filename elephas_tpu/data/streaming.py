@@ -134,6 +134,77 @@ class ShardedStream:
         return row * self.batch_size * self.block_steps * self.num_workers
 
 
+def prefetch_blocks(block_iter, depth: int = 1):
+    """Background-thread block prefetch (bounded queue).
+
+    JAX async dispatch already hides ONE block's staging under compute;
+    a reader thread goes further — numpy/memmap/h5py row gathers release
+    the GIL during IO, so upcoming blocks gather in parallel with device
+    compute AND with the consumer's ``device_put``. Peak host memory is
+    ``depth + 2`` blocks (queued + gathering + consumed); the default 1
+    keeps that near the previous one-ahead pattern's bound. Exceptions
+    from the reader re-raise at the consumer."""
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    sentinel = object()
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def reader():
+        try:
+            it = iter(block_iter)
+            while not stop.is_set():  # checked BEFORE each gather: an
+                # abandoned consumer must not trigger one more block of IO
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            errors.append(e)
+        finally:
+            # the sentinel MUST land (a dropped sentinel deadlocks the
+            # consumer's q.get()) — block for space, but stay
+            # interruptible by the stop flag
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    thread = threading.Thread(target=reader, daemon=True, name="block-prefetch")
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                thread.join()
+                if errors:
+                    raise errors[0]
+                return
+            yield item
+    finally:
+        # consumer abandoned mid-epoch (exception in the train step,
+        # generator GC): release the reader — otherwise it blocks
+        # forever on the bounded queue, pinning gathered blocks and the
+        # backing store
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        thread.join(timeout=5)
+
+
 class ConcatRows:
     """Sliceable concatenation of row-range views over backing stores —
     the bridge from a lazy :class:`~elephas_tpu.data.rdd.Rdd` (partitions

@@ -524,11 +524,13 @@ class ShardedTrainer(KerasIntrospection):
         tv, ntv, ov = self._state
         dp = self.dp
 
+        from elephas_tpu.data.streaming import prefetch_blocks
+
         history: dict[str, list[float]] = {"loss": []}
         for epoch in range(epochs):
             mvs = self._zero_mvs(metric_objects)
             losses: list[tuple] = []
-            for xb, yb, steps in stream.blocks():
+            for xb, yb, steps in prefetch_blocks(stream.blocks()):
                 # [DP, steps, B, ...] → per-step [DP, B, ...]
                 for t in range(steps):
                     xt, yt = xb[:, t], yb[:, t]

@@ -183,6 +183,7 @@ class SparkModel:
         resume: bool = False,
         steps_per_epoch: int | None = None,
         stream_block_steps: int | None = None,
+        history_log: str | None = None,
         **kwargs,
     ) -> dict:
         """Train on a simple RDD of ``(x_row, y_row)`` pairs — or on an
@@ -222,6 +223,7 @@ class SparkModel:
                 resume=resume,
                 steps_per_epoch=steps_per_epoch,
                 stream_block_steps=stream_block_steps,
+                history_log=history_log,
             )
         if rdd.is_lazy() and self.frequency != "fit":
             # partitions are row-range views of backing stores — stream
@@ -247,6 +249,7 @@ class SparkModel:
                 resume=resume,
                 steps_per_epoch=steps_per_epoch,
                 stream_block_steps=stream_block_steps,
+                history_log=history_log,
             )
         if not rdd.is_lazy() and rdd.getNumPartitions() != self.num_workers:
             # lazy RDDs skip the element-wise repartition (it would
@@ -264,6 +267,7 @@ class SparkModel:
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
             resume=resume,
+            history_log=history_log,
         )
 
     def _fit_arrays(
@@ -347,6 +351,7 @@ class SparkModel:
         resume=False,
         stream=None,
         val_partitions=None,
+        history_log=None,
     ) -> dict:
         runner = self._get_runner()
 
@@ -390,6 +395,33 @@ class SparkModel:
                         runner.save_checkpoint(checkpoint_dir, done)
 
                 callbacks.append(save_ckpt)
+            if history_log:
+                # epoch-level JSONL metrics export (SURVEY.md §5: the
+                # reference has none) — live lines per epoch from the
+                # coordinator, one final line with the full history
+                import time as _time
+
+                from elephas_tpu.parallel.distributed import is_coordinator
+
+                t_start = _time.time()
+                if is_coordinator():
+
+                    def log_epoch(epoch, loss):
+                        with open(history_log, "a") as f:
+                            f.write(
+                                json.dumps(
+                                    {
+                                        "epoch": start_epoch + epoch + 1,
+                                        "loss": float(loss),
+                                        "elapsed_s": round(
+                                            _time.time() - t_start, 3
+                                        ),
+                                    }
+                                )
+                                + "\n"
+                            )
+
+                    callbacks.append(log_epoch)
             val_history: dict[str, list[float]] = {}
             if val_partitions is not None and self.frequency != "fit":
                 # per-epoch validation, like keras.fit's val_* history
@@ -427,6 +459,15 @@ class SparkModel:
                 # terminal snapshot regardless of checkpoint_every cadence
                 runner.save_checkpoint(checkpoint_dir, start_epoch + epochs, history)
             history.update(val_history)
+            if history_log:
+                from elephas_tpu.parallel.distributed import is_coordinator
+
+                if is_coordinator():
+                    with open(history_log, "a") as f:
+                        f.write(
+                            json.dumps({"final": True, "history": history})
+                            + "\n"
+                        )
             self._publish_weights()
         finally:
             self.stop_server()

@@ -587,6 +587,8 @@ class MeshRunner(KerasIntrospection):
         # multi-host: gather only this process's workers' rows from the
         # backing store (VERDICT r2 weak #3 — full-block gathers multiply
         # storage bandwidth by the process count)
+        from elephas_tpu.data.streaming import prefetch_blocks
+
         local_idx = (
             self._local_worker_indices() if jax.process_count() > 1 else None
         )
@@ -594,10 +596,11 @@ class MeshRunner(KerasIntrospection):
         for epoch in range(epochs):
             mvs = None  # accumulated block contributions (additive states)
             losses: list[tuple] = []
-            blocks = stream.blocks(worker_indices=local_idx)
-            nxt = next(blocks, None)
-            while nxt is not None:
-                xs, ys, steps = nxt
+            # background reader keeps blocks ahead of the device (gathers
+            # overlap compute beyond async-dispatch depth)
+            for xs, ys, steps in prefetch_blocks(
+                stream.blocks(worker_indices=local_idx)
+            ):
                 xb, yb = self._shard_local_data(xs), self._shard_local_data(ys)
                 zero_mvs = self._zero_metric_state(metric_objects)
                 tv, ntv, ov, block_mvs, loss = self._epoch_fn(
@@ -609,8 +612,6 @@ class MeshRunner(KerasIntrospection):
                     else jax.tree.map(jnp.add, mvs, block_mvs)
                 )
                 losses.append((loss, steps))
-                # gather the next chunk while devices chew on this block
-                nxt = next(blocks, None)
             total_steps = sum(s for _, s in losses)
             epoch_loss = (
                 sum(float(np.asarray(l)) * s for l, s in losses) / total_steps

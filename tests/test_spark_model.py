@@ -381,3 +381,23 @@ def test_evaluate_order_pinned_to_metrics_names(spark_context, blobs):
     # a keras version exposes a flat list again)
     assert len(dist) == len(ref) == 5, (dist, ref)
     np.testing.assert_allclose(dist, ref, atol=1e-3)
+
+
+def test_history_log_jsonl(tmp_path, spark_context, blobs):
+    """r3: epoch-level metrics export (SURVEY §5 lists none upstream) —
+    one live JSONL line per epoch plus a final full-history line."""
+    import json
+
+    x, y, d, k = blobs
+    log_path = str(tmp_path / "history.jsonl")
+    sm = SparkModel(make_mlp(d, k, seed=55), num_workers=8)
+    rdd = to_simple_rdd(spark_context, x, y)
+    history = sm.fit(rdd, epochs=3, batch_size=32, validation_split=0.2,
+                     history_log=log_path)
+    lines = [json.loads(l) for l in open(log_path)]
+    epoch_lines = [l for l in lines if "epoch" in l]
+    final = [l for l in lines if l.get("final")]
+    assert [l["epoch"] for l in epoch_lines] == [1, 2, 3]
+    assert all(np.isfinite(l["loss"]) for l in epoch_lines)
+    assert len(final) == 1
+    assert final[0]["history"]["val_loss"] == history["val_loss"]

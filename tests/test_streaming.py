@@ -239,3 +239,31 @@ def test_blocks_gather_only_requested_workers(blobs):
     assert touched and touched <= set(range(400, 600)) | set(range(1000, 1200)), (
         min(touched), max(touched), len(touched),
     )
+
+
+def test_prefetch_reader_released_on_abandonment():
+    """code-review r3: abandoning the prefetch generator mid-epoch
+    (train-step exception) must release the reader thread, not leave it
+    blocked on the bounded queue."""
+    import threading
+
+    from elephas_tpu.data.streaming import prefetch_blocks
+
+    produced = []
+
+    def slow_blocks():
+        for i in range(100):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    gen = prefetch_blocks(slow_blocks(), depth=2)
+    assert next(gen) == 0
+    gen.close()  # abandon mid-stream (what an exception in the consumer does)
+    import time
+
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "reader thread leaked"
+    assert len(produced) < 100, "reader ran to completion despite abandonment"

@@ -50,6 +50,11 @@ def main(trace_dir: str) -> None:
 
     busy = sum(dur.values())
     window = hi - lo
+    if not dur or window <= 0:
+        raise SystemExit(
+            "no TPU device events in this trace (CPU-only capture?) — "
+            "nothing to analyze"
+        )
     print(f"device window: {window/1e6:.3f}s   leaf-kernel busy: "
           f"{busy/1e6:.3f}s   busy fraction: {busy/window*100:.1f}%")
     print("top kernels by self time:")
