@@ -70,6 +70,15 @@ def _synthetic(n, img, classes, seed=0):
     return x, y
 
 
+def _synthetic_tokens(n, maxlen, vocab, classes, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    x = rng.integers(1, vocab, size=(n, maxlen)).astype(np.int32)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    return x, y
+
+
 def measure_spark_fit(model, x, y, batch_size, epochs, num_workers,
                       profile_dir=None):
     """Steady-state images/sec of the compiled distributed epoch program.
@@ -300,6 +309,10 @@ def measure_keras_fit(model, x, y, batch_size, epochs):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset", choices=["auto", "full", "tiny"], default="auto")
+    p.add_argument("--model", choices=["resnet", "transformer"], default="resnet",
+                   help="transformer = flash-attention encoder (matmul-"
+                        "dominated secondary benchmark; the MXU ceiling "
+                        "without the conv bound)")
     p.add_argument("--no-baseline", action="store_true")
     p.add_argument("--glue-baseline", action="store_true",
                    help="also measure stock keras.fit (numpy glue path)")
@@ -322,27 +335,51 @@ def main():
         preset = "tiny" if backend == "cpu" else "full"
     log.info("backend=%s chips=%d preset=%s", backend, n_chips, preset)
 
-    from elephas_tpu.models import resnet, resnet50
+    from elephas_tpu.models import resnet, resnet50, transformer_classifier
 
-    if preset == "full":
-        img, classes, batch, nb = 224, 1000, 256, 4
-        make = lambda: resnet50(  # noqa: E731
-            input_shape=(img, img, 3),
-            num_classes=classes,
-            dtype_policy="mixed_bfloat16",
+    unit_scale = 1  # units per sample (tokens for the transformer)
+    if args.model == "transformer":
+        if preset == "full":
+            # d=1024 fills the MXU (measured ~32% analytic MFU on v5e;
+            # d=512 sat at ~19%)
+            maxlen, vocab, d_model, layers, batch, nb = 256, 8192, 1024, 4, 64, 4
+        else:
+            maxlen, vocab, d_model, layers, batch, nb = 32, 256, 64, 1, 8, 4
+        classes = 2
+        unit_scale = maxlen
+        make = lambda: transformer_classifier(  # noqa: E731
+            vocab_size=vocab, maxlen=maxlen, num_classes=classes,
+            d_model=d_model, num_heads=max(2, d_model // 64),
+            num_layers=layers, dropout=0.0,
+            dtype_policy="mixed_bfloat16" if preset == "full" else None,
         )
+        gen = lambda n: _synthetic_tokens(n, maxlen, vocab, classes)  # noqa: E731
+        unit_name = "tokens/sec/chip"
+        sample_name = "sequence"
+        model_name = f"flash-attention transformer (S={maxlen}, d={d_model})"
     else:
-        img, classes, batch, nb = 32, 10, 8, 4
-        make = lambda: resnet(  # noqa: E731
-            input_shape=(img, img, 3),
-            num_classes=classes,
-            depths=(1, 1),
-            width=16,
-        )
+        if preset == "full":
+            img, classes, batch, nb = 224, 1000, 256, 4
+            make = lambda: resnet50(  # noqa: E731
+                input_shape=(img, img, 3),
+                num_classes=classes,
+                dtype_policy="mixed_bfloat16",
+            )
+        else:
+            img, classes, batch, nb = 32, 10, 8, 4
+            make = lambda: resnet(  # noqa: E731
+                input_shape=(img, img, 3),
+                num_classes=classes,
+                depths=(1, 1),
+                width=16,
+            )
+        gen = lambda n: _synthetic(n, img, classes)  # noqa: E731
+        unit_name = "images/sec/chip"
+        sample_name = "image"
+        model_name = "ResNet-50"
     if args.batch:
         batch = args.batch
-
-    x, y = _synthetic(nb * batch * max(1, n_chips), img, classes)
+    x, y = gen(nb * batch * max(1, n_chips))
     ips, dt = measure_spark_fit(
         make(), x, y, batch, args.epochs, None, profile_dir=args.profile_dir
     )
@@ -419,24 +456,28 @@ def main():
             log.info("glue baseline failed (%s)", e)
 
     out = {
-        "metric": f"SparkModel.fit ResNet-50 images/sec/chip ({preset}, {backend})",
-        "value": round(ips_chip, 2),
-        "unit": "images/sec/chip",
+        "metric": (
+            f"SparkModel.fit {model_name} {unit_name} ({preset}, {backend})"
+        ),
+        "value": round(ips_chip * unit_scale, 2),
+        "unit": unit_name,
         "vs_baseline": round(vs_baseline, 3),
     }
+    # every throughput field rides unit_scale so all numbers in the JSON
+    # share ONE unit (tokens for the transformer, images for resnet)
     if mfu == mfu:
         out["mfu"] = round(mfu, 4)
-        out["flops_per_image"] = round(flops_per_img / 1e9, 3)
+        out[f"gflops_per_{sample_name}"] = round(flops_per_img / 1e9, 3)
         out["peak_tflops_bf16"] = round(peak / 1e12, 1)
     if base_ips == base_ips:
-        out["baseline_jit_ips"] = round(base_ips, 2)
+        out["baseline_jit"] = round(base_ips * unit_scale, 2)
     if stream_ips is not None:
-        out["stream_ips"] = round(stream_ips, 2)
+        out["stream"] = round(stream_ips * unit_scale, 2)
         out["stream_vs_staged"] = round(stream_ips / ips, 3)
     if scaling is not None:
         out["weak_scaling"] = scaling
     if glue_ips is not None:
-        out["glue_keras_fit_ips"] = round(glue_ips, 2)
+        out["glue_keras_fit"] = round(glue_ips * unit_scale, 2)
     if args.profile_dir:
         out["profile_dir"] = args.profile_dir
     print(json.dumps(out))

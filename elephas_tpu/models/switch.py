@@ -186,7 +186,8 @@ def switch_transformer_classifier(
         name = f"blk{b}"
         h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln1")(x)
         h = FlashMHA(num_heads, head_dim, name=f"{name}_attn")(h)
-        h = L.Dropout(dropout, name=f"{name}_drop1")(h)
+        if dropout > 0:
+            h = L.Dropout(dropout, name=f"{name}_drop1")(h)
         x = L.Add(name=f"{name}_res1")([x, h])
         h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln2")(x)
         h = MoeFFN(
@@ -197,7 +198,8 @@ def switch_transformer_classifier(
             aux_weight=aux_weight,
             name=f"{name}_moe",
         )(h)
-        h = L.Dropout(dropout, name=f"{name}_drop2")(h)
+        if dropout > 0:
+            h = L.Dropout(dropout, name=f"{name}_drop2")(h)
         x = L.Add(name=f"{name}_res2")([x, h])
     x = L.LayerNormalization(epsilon=1e-6, name="final_ln")(x)
     x = L.GlobalAveragePooling1D(name="pool")(x)

@@ -109,13 +109,18 @@ def __getattr__(name):
 def _block(x, num_heads, head_dim, mlp_ratio, dropout, causal, name, L, FlashMHA):
     h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln1")(x)
     h = FlashMHA(num_heads, head_dim, causal=causal, name=f"{name}_attn")(h)
-    h = L.Dropout(dropout, name=f"{name}_drop1")(h)
+    if dropout > 0:
+        # rate-0 Dropout layers are elided entirely: dead ops, and their
+        # python `if training` branch breaks keras.RematScope (jax.remat
+        # traces the training flag)
+        h = L.Dropout(dropout, name=f"{name}_drop1")(h)
     x = L.Add(name=f"{name}_res1")([x, h])
     h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln2")(x)
     d_model = x.shape[-1]
     h = L.Dense(int(d_model * mlp_ratio), activation="gelu", name=f"{name}_mlp1")(h)
     h = L.Dense(d_model, name=f"{name}_mlp2")(h)
-    h = L.Dropout(dropout, name=f"{name}_drop2")(h)
+    if dropout > 0:
+        h = L.Dropout(dropout, name=f"{name}_drop2")(h)
     return L.Add(name=f"{name}_res2")([x, h])
 
 
@@ -139,26 +144,39 @@ def transformer_classifier(
     dropout: float = 0.1,
     lr: float = 1e-3,
     seed: int = 0,
+    dtype_policy: str | None = None,
 ):
-    """Encoder-stack text classifier (IMDB-class tasks, BASELINE #4+)."""
+    """Encoder-stack text classifier (IMDB-class tasks, BASELINE #4+).
+
+    ``dtype_policy='mixed_bfloat16'`` keeps the matmuls (and the flash
+    attention kernel) in bf16 on the MXU with float32 variables."""
     keras = _keras()
     keras.utils.set_random_seed(seed)
-    L = keras.layers
-    FlashMHA = _flash_mha_layer()
-    head_dim = d_model // num_heads
+    prev_policy = keras.config.dtype_policy()
+    if dtype_policy is not None:
+        keras.config.set_dtype_policy(dtype_policy)
+    try:
+        L = keras.layers
+        FlashMHA = _flash_mha_layer()
+        head_dim = d_model // num_heads
 
-    inputs = keras.Input((maxlen,), dtype="int32")
-    x = L.Embedding(vocab_size, d_model, name="tok_embed")(inputs)
-    x = x + _positions(maxlen, d_model)[None]
-    for b in range(num_layers):
-        x = _block(
-            x, num_heads, head_dim, mlp_ratio, dropout, False, f"blk{b}", L, FlashMHA
-        )
-    x = L.LayerNormalization(epsilon=1e-6, name="final_ln")(x)
-    x = L.GlobalAveragePooling1D(name="pool")(x)
-    activation = "sigmoid" if num_classes == 1 else "softmax"
-    outputs = L.Dense(num_classes, activation=activation, name="head")(x)
-    model = keras.Model(inputs, outputs, name="transformer_classifier")
+        inputs = keras.Input((maxlen,), dtype="int32")
+        x = L.Embedding(vocab_size, d_model, name="tok_embed")(inputs)
+        x = x + _positions(maxlen, d_model)[None]
+        for b in range(num_layers):
+            x = _block(
+                x, num_heads, head_dim, mlp_ratio, dropout, False,
+                f"blk{b}", L, FlashMHA,
+            )
+        x = L.LayerNormalization(epsilon=1e-6, name="final_ln")(x)
+        x = L.GlobalAveragePooling1D(name="pool")(x)
+        activation = "sigmoid" if num_classes == 1 else "softmax"
+        outputs = L.Dense(
+            num_classes, activation=activation, name="head", dtype="float32"
+        )(x)
+        model = keras.Model(inputs, outputs, name="transformer_classifier")
+    finally:
+        keras.config.set_dtype_policy(prev_policy)
     loss = (
         "binary_crossentropy"
         if num_classes == 1

@@ -119,3 +119,32 @@ def test_transformer_lm_shapes_and_step():
     y = np.roll(x, -1, axis=1)
     h = model.fit(x, y, epochs=1, batch_size=2, verbose=0)
     assert np.isfinite(h.history["loss"][0])
+
+
+def test_remat_transformer_trains():
+    """r3: keras.RematScope composes with the flash-attention transformer
+    (rate-0 Dropout layers are elided — their python `if training` breaks
+    jax.remat's traced flag; keras limitation)."""
+    import keras
+    import numpy as np
+
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import transformer_classifier
+
+    rng = np.random.default_rng(0)
+    n, maxlen, vocab = 128, 16, 64
+    y = rng.integers(0, 2, size=n).astype(np.int32)
+    half = vocab // 2
+    mask = rng.random((n, maxlen)) < np.where(y[:, None] == 1, 0.8, 0.2)
+    x = np.where(mask, rng.integers(half, vocab, size=(n, maxlen)),
+                 rng.integers(1, half, size=(n, maxlen))).astype(np.int32)
+
+    with keras.RematScope(mode="full"):
+        model = transformer_classifier(
+            vocab_size=vocab, maxlen=maxlen, num_classes=2,
+            d_model=32, num_heads=2, num_layers=1, dropout=0.0, seed=3,
+        )
+    sm = SparkModel(model, num_workers=8)
+    history = sm.fit((x, y), epochs=2, batch_size=16)
+    assert np.isfinite(history["loss"]).all()
+    assert history["loss"][-1] < history["loss"][0]

@@ -401,3 +401,45 @@ def test_history_log_jsonl(tmp_path, spark_context, blobs):
     assert all(np.isfinite(l["loss"]) for l in epoch_lines)
     assert len(final) == 1
     assert final[0]["history"]["val_loss"] == history["val_loss"]
+
+
+def test_remat_scope_models_train_identically(blobs):
+    """r3: keras.RematScope (activation rematerialization — the HBM
+    memory lever on TPU) composes with the compiled distributed path:
+    a rematerialized model trains to the same weights as the plain one
+    (remat changes memory, never math)."""
+    import keras
+
+    x, y, d, k = blobs
+    x, y = x[:640], y[:640]
+
+    def build(seed, remat):
+        keras.utils.set_random_seed(seed)
+        import contextlib
+
+        ctx = keras.RematScope(mode="full") if remat else contextlib.nullcontext()
+        with ctx:
+            model = keras.Sequential(
+                [
+                    keras.layers.Input((d,)),
+                    keras.layers.Dense(32, activation="relu"),
+                    keras.layers.Dense(k, activation="softmax"),
+                ]
+            )
+        model.compile(
+            optimizer=keras.optimizers.SGD(0.05),
+            loss="sparse_categorical_crossentropy",
+            metrics=["accuracy"],
+        )
+        return model
+
+    sm_plain = SparkModel(build(61, False), num_workers=8)
+    h1 = sm_plain.fit((x, y), epochs=2, batch_size=32)
+    sm_remat = SparkModel(build(61, True), num_workers=8)
+    h2 = sm_remat.fit((x, y), epochs=2, batch_size=32)
+    np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=1e-5)
+    for a, b in zip(
+        sm_plain.master_network.get_weights(),
+        sm_remat.master_network.get_weights(),
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-6)
