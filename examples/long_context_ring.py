@@ -1,0 +1,86 @@
+"""Long-context training with ring attention — sequence parallelism demo.
+
+Beyond the reference (SURVEY.md §5 lists sequence parallelism as absent
+upstream): the sequence axis shards over the device mesh, KV blocks
+rotate between neighbors via ``lax.ppermute`` on ICI, and the custom
+ring-pass VJP trains end-to-end — sequences longer than any one chip's
+memory train with exact attention math.
+
+The task plants a marker token in one half of a long sequence; the
+label says which half. A shard-local model cannot solve it — the
+attention must span shards.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--d-model", type=int, default=32)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--batch", type=int, default=32)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    from elephas_tpu.ops.ring_attention import ring_attention_sharded
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("seq",))
+    S, D, V, B = args.seq_len, args.d_model, 64, args.batch
+    assert S % len(devices) == 0, "seq len must divide the mesh"
+    print(f"{len(devices)} sequence shards of {S // len(devices)} tokens")
+
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, size=B).astype(np.int32)
+    x = rng.integers(4, V, size=(B, S)).astype(np.int32)
+    pos = rng.integers(0, S // 2, size=B) + np.where(y == 1, S // 2, 0)
+    x[np.arange(B), pos] = 1  # the marker
+
+    key = jax.random.PRNGKey(1)
+    ks = jax.random.split(key, 5)
+    params = {
+        "emb": jax.random.normal(ks[0], (V, D)) * 0.5,
+        "wq": jax.random.normal(ks[1], (D, D)) * D**-0.5,
+        "wk": jax.random.normal(ks[2], (D, D)) * D**-0.5,
+        "wv": jax.random.normal(ks[3], (D, D)) * D**-0.5,
+        "head": jax.random.normal(ks[4], (D, 2)) * 0.2,
+    }
+
+    def forward(params, xb):
+        h = params["emb"][xb]
+        q, k, v = h @ params["wq"], h @ params["wk"], h @ params["wv"]
+        att = ring_attention_sharded(q, k, v, mesh, axis_name="seq")
+        return (att + h).mean(axis=1) @ params["head"]
+
+    def loss_fn(params, xb, yb):
+        logp = jax.nn.log_softmax(forward(params, xb))
+        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1))
+
+    opt = optax.adam(3e-2)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    for i in range(args.steps):
+        params, state, loss = step(params, state)
+        if (i + 1) % 20 == 0:
+            print(f"step {i + 1}: loss {float(loss):.4f}")
+    preds = np.asarray(forward(params, x)).argmax(-1)
+    acc = float((preds == y).mean())
+    print(f"accuracy over {S}-token sequences: {acc:.3f}")
+    assert acc > 0.9, acc
+
+
+if __name__ == "__main__":
+    main()

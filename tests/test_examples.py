@@ -39,6 +39,13 @@ def test_hyperparam_optimization():
     run_example("hyperparam_optimization", ["--max-evals", "3", "--epochs", "1"])
 
 
+def test_long_context_ring():
+    run_example(
+        "long_context_ring",
+        ["--seq-len", "128", "--steps", "40", "--batch", "32"],
+    )
+
+
 def test_switch_moe_transformer():
     run_example(
         "switch_moe_transformer",
