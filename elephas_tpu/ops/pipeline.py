@@ -350,8 +350,10 @@ class GPipeTrainer:
 
     # -- API -------------------------------------------------------------
 
-    def fit(self, x, y, epochs: int = 1, batch_size: int = 32, verbose: int = 0):
+    def fit(self, x, y, epochs: int = 1, batch_size: int = 32, verbose: int = 0,
+            callbacks=None):
         """Mini-batch training; returns ``{'loss': [...]}`` per epoch.
+        ``callbacks`` are ``cb(epoch, loss)`` at epoch boundaries.
 
         ``batch_size`` is rounded up to a multiple of ``M`` (each
         microbatch keeps a fixed shape); the final short batch wrap-pads
@@ -395,6 +397,9 @@ class GPipeTrainer:
                 logger.info(
                     "epoch %d/%d - loss %.4f", epoch + 1, epochs, epoch_loss
                 )
+            if callbacks:
+                for cb in callbacks:
+                    cb(epoch, epoch_loss)
         return history
 
     def predict(self, x, batch_size: int = 32) -> np.ndarray:

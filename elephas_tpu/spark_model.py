@@ -46,6 +46,8 @@ class SparkModel:
         batch_size: int = 32,
         port: int = 4000,
         model_parallel: int = 1,
+        pipeline_parallel: int = 1,
+        pipeline_microbatches: int = 4,
         *args,
         **kwargs,
     ):
@@ -81,7 +83,40 @@ class SparkModel:
         self.batch_size = batch_size
         self.port = port
         self.model_parallel = int(model_parallel)
+        self.pipeline_parallel = int(pipeline_parallel)
+        self.pipeline_microbatches = int(pipeline_microbatches)
         self.kwargs = kwargs
+
+        if self.model_parallel > 1 and self.pipeline_parallel > 1:
+            raise ValueError(
+                "model_parallel and pipeline_parallel are separate "
+                "strategies here — pick one (composing them is a future "
+                "extension)"
+            )
+        if self.pipeline_parallel > 1:
+            import jax
+
+            if self.pipeline_parallel > len(jax.devices()):
+                raise ValueError(
+                    f"pipeline_parallel={pipeline_parallel} exceeds the "
+                    f"{len(jax.devices())} available devices"
+                )
+            if self.mode != "synchronous":
+                raise ValueError(
+                    "pipeline_parallel trains synchronously (one model, "
+                    "depth-sharded); asynchronous/hogwild modes apply to "
+                    "data-parallel replicas"
+                )
+            from jax.sharding import Mesh
+
+            self.mesh = Mesh(
+                np.array(jax.devices()[: self.pipeline_parallel]), ("stages",)
+            )
+            self.num_workers = self.pipeline_parallel
+            self._runner = None
+            self._parameter_server = None
+            self.training_histories = []
+            return
 
         if self.model_parallel > 1:
             # models bigger than one chip: 2-D ('data', 'model') mesh —
@@ -127,6 +162,8 @@ class SparkModel:
             "batch_size": self.batch_size,
             "port": self.port,
             "model_parallel": self.model_parallel,
+            "pipeline_parallel": self.pipeline_parallel,
+            "pipeline_microbatches": self.pipeline_microbatches,
         }
 
     # -- parameter server (API parity; see module docstring) -----------
@@ -302,6 +339,17 @@ class SparkModel:
             or lazily_backed
             or estimate_nbytes(x, y) > self.STREAM_THRESHOLD_BYTES
         )
+        if self.pipeline_parallel > 1 and should_stream:
+            # the pipeline runner has no streaming path yet; in-memory
+            # arrays can always stage (GPipeTrainer feeds per-batch), so
+            # only explicit streaming requests / lazy sources must fail
+            if lazily_backed or stream_block_steps is not None or steps_per_epoch:
+                raise ValueError(
+                    "out-of-core streaming is not supported with "
+                    "pipeline_parallel yet; stage the dataset or use "
+                    "model_parallel/data-parallel"
+                )
+            should_stream = False
         if not should_stream:
             xs = np.array_split(x, self.num_workers)
             ys = np.array_split(y, self.num_workers)
@@ -548,7 +596,16 @@ class SparkModel:
 
     def _get_runner(self):
         if self._runner is None:
-            if self.model_parallel > 1:
+            if self.pipeline_parallel > 1:
+                from elephas_tpu.parallel.pipeline_runner import PipelineRunner
+
+                self._runner = PipelineRunner(
+                    self._master_network,
+                    self.pipeline_parallel,
+                    num_microbatches=self.pipeline_microbatches,
+                    mesh=self.mesh,
+                )
+            elif self.model_parallel > 1:
                 from elephas_tpu.parallel.tensor import TensorParallelRunner
 
                 self._runner = TensorParallelRunner(
@@ -608,4 +665,6 @@ def load_spark_model(file_name: str) -> SparkModel:
         batch_size=config.get("batch_size", 32),
         port=config.get("port", 4000),
         model_parallel=config.get("model_parallel", 1),
+        pipeline_parallel=config.get("pipeline_parallel", 1),
+        pipeline_microbatches=config.get("pipeline_microbatches", 4),
     )

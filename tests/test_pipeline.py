@@ -300,3 +300,246 @@ def test_gpipe_trainer_embedding_stage_int_inputs():
     preds = trainer.predict(x)
     acc = float((preds.argmax(1) == y).mean())
     assert acc > 0.85, acc
+
+
+# -- r3: PP behind the parity API ----------------------------------------
+
+
+def _pp_mlp(d, k, seed=0, lr=1e-2):
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((d,)),
+            keras.layers.Dense(48, activation="relu", name="fc1"),
+            keras.layers.Dense(32, activation="relu", name="fc2"),
+            keras.layers.Dense(24, activation="relu", name="fc3"),
+            keras.layers.Dense(k, activation="softmax", name="head"),
+        ]
+    )
+    model.compile(
+        optimizer=keras.optimizers.Adam(lr),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    return model
+
+
+def test_spark_model_pipeline_parallel_trains(blobs):
+    """SparkModel(pipeline_parallel=2): the keras model splits into
+    balanced stages, trains through the GPipe ring, and the L5 surface
+    (fit/evaluate/predict) works end to end."""
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    sm = SparkModel(_pp_mlp(d, k, seed=71), pipeline_parallel=2)
+    assert sm.num_workers == 2
+    runner = sm._get_runner()
+    stages = runner.stage_summary()
+    assert len(stages) == 2 and all(stages), stages
+    history = sm.fit((x, y), epochs=6, batch_size=64)
+    assert history["loss"][-1] < history["loss"][0] * 0.5, history
+    loss, acc = sm.evaluate(x, y)
+    assert acc > 0.9, acc
+    preds = sm.predict(x[:50])
+    assert preds.shape == (50, k)
+
+
+def test_pipeline_parallel_matches_single_device(blobs):
+    """PP training must equal single-device training on the same data:
+    same layers, same adam (optax mirror), same microbatch-mean loss."""
+    import keras
+    import optax
+
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    x, y = x[:256], y[:256]
+
+    sm = SparkModel(_pp_mlp(d, k, seed=73), pipeline_parallel=2,
+                    pipeline_microbatches=4)
+    h_pp = sm.fit((x, y), epochs=4, batch_size=64)
+
+    # oracle: same composite trained with optax adam at the same
+    # microbatch-mean loss
+    ref = _pp_mlp(d, k, seed=73)
+    params = [
+        [jnp.asarray(v.value) for v in l.trainable_variables]
+        for l in ref.layers
+    ]
+
+    def forward(ps, xb):
+        h = xb
+        for layer, tv in zip(ref.layers, ps):
+            h, _ = layer.stateless_call(tv, [], h, training=True)
+        return h
+
+    def loss_fn(ps, xb, yb):
+        y_pred = forward(ps, xb)
+        logp = jnp.log(jnp.clip(y_pred, 1e-7, 1.0))
+        per = -jnp.take_along_axis(logp, yb[:, None].astype(jnp.int32), 1)[:, 0]
+        return jnp.mean(per)
+
+    def mb_loss(ps, xb, yb):
+        losses = [
+            loss_fn(ps, xm, ym)
+            for xm, ym in zip(xb.reshape(4, -1, d), yb.reshape(4, -1))
+        ]
+        return jnp.mean(jnp.stack(losses))
+
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+    step = jax.jit(
+        lambda ps, st, xb, yb: (
+            lambda lg: (
+                optax.apply_updates(ps, opt.update(lg[1], st, ps)[0]),
+                opt.update(lg[1], st, ps)[1],
+                lg[0],
+            )
+        )(jax.value_and_grad(mb_loss)(ps, xb, yb))
+    )
+    oracle = []
+    for _ in range(4):
+        losses = []
+        for b in range(4):  # 256/64
+            params, state, l = step(
+                params, state, x[b * 64 : (b + 1) * 64], y[b * 64 : (b + 1) * 64]
+            )
+            losses.append(float(l))
+        oracle.append(float(np.mean(losses)))
+    np.testing.assert_allclose(h_pp["loss"], oracle, rtol=5e-4)
+
+
+def test_pipeline_parallel_guards(blobs):
+    """Config guards: tp+pp exclusive, async rejected, stateful layers
+    rejected, streaming rejected."""
+    import keras
+
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    with pytest.raises(ValueError, match="pick one"):
+        SparkModel(_pp_mlp(d, k), model_parallel=2, pipeline_parallel=2)
+    with pytest.raises(ValueError, match="synchronous"):
+        SparkModel(_pp_mlp(d, k), mode="asynchronous", pipeline_parallel=2)
+
+    keras.utils.set_random_seed(0)
+    bn = keras.Sequential(
+        [
+            keras.layers.Input((d,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.BatchNormalization(),
+            keras.layers.Dense(k, activation="softmax"),
+        ]
+    )
+    bn.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    sm = SparkModel(bn, pipeline_parallel=2)
+    with pytest.raises(ValueError, match="non-trainable state"):
+        sm.fit((x[:64], y[:64]), epochs=1, batch_size=16)
+
+    sm2 = SparkModel(_pp_mlp(d, k), pipeline_parallel=2)
+    with pytest.raises(ValueError, match="streaming"):
+        sm2.fit((x, y), epochs=1, batch_size=32, stream_block_steps=2)
+
+
+def test_pipeline_parallel_checkpoint_resume(tmp_path, blobs):
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    ckdir = str(tmp_path / "pp_ck")
+    full = SparkModel(_pp_mlp(d, k, seed=77), pipeline_parallel=2)
+    full.fit((x, y), epochs=4, batch_size=64)
+
+    part = SparkModel(_pp_mlp(d, k, seed=77), pipeline_parallel=2)
+    part.fit((x, y), epochs=2, batch_size=64, checkpoint_dir=ckdir)
+    resumed = SparkModel(_pp_mlp(d, k, seed=77), pipeline_parallel=2)
+    resumed.fit((x, y), epochs=4, batch_size=64, checkpoint_dir=ckdir,
+                resume=True)
+    for a, b in zip(
+        full.master_network.get_weights(), resumed.master_network.get_weights()
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4)
+
+
+def test_pipeline_parallel_more_guards(blobs):
+    """code-review r3: functional graphs, LR schedules, unmappable
+    optimizer options, 4-stage splits, and microbatch config round-trip."""
+    import keras
+
+    from elephas_tpu import SparkModel, load_spark_model
+
+    x, y, d, k = blobs
+
+    # functional model with a residual Add: 1-in/1-out but NOT a chain
+    keras.utils.set_random_seed(0)
+    inp = keras.Input((d,))
+    h = keras.layers.Dense(d, activation="relu")(inp)
+    out = keras.layers.Dense(k, activation="softmax")(
+        keras.layers.Add()([h, inp])
+    )
+    res = keras.Model(inp, out)
+    res.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    with pytest.raises(ValueError, match="Sequential"):
+        SparkModel(res, pipeline_parallel=2).fit((x[:64], y[:64]), epochs=1)
+
+    # LR schedule → clear error
+    m = _pp_mlp(d, k)
+    m.compile(
+        optimizer=keras.optimizers.Adam(
+            keras.optimizers.schedules.ExponentialDecay(1e-2, 100, 0.9)
+        ),
+        loss="sparse_categorical_crossentropy",
+    )
+    with pytest.raises(ValueError, match="LearningRateSchedule"):
+        SparkModel(m, pipeline_parallel=2).fit((x[:64], y[:64]), epochs=1)
+
+    # clipnorm → clear error, not silent divergence
+    m2 = _pp_mlp(d, k)
+    m2.compile(
+        optimizer=keras.optimizers.Adam(1e-2, clipnorm=1.0),
+        loss="sparse_categorical_crossentropy",
+    )
+    with pytest.raises(ValueError, match="clipnorm"):
+        SparkModel(m2, pipeline_parallel=2).fit((x[:64], y[:64]), epochs=1)
+
+    # 4 stages over 4 layers: singleton groups (feasibility force-close)
+    sm4 = SparkModel(_pp_mlp(d, k, seed=79), pipeline_parallel=4)
+    stages = sm4._get_runner().stage_summary()
+    assert len(stages) == 4 and all(len(s) == 1 for s in stages), stages
+    h = sm4.fit((x[:256], y[:256]), epochs=1, batch_size=64)
+    assert np.isfinite(h["loss"]).all()
+
+    # pipeline_microbatches survives save/load
+    import os
+
+    sm4.save(str(__import__("tempfile").mkdtemp()) + "/pp4.keras")  # noqa
+    # use get_config directly (save/load covered elsewhere)
+    cfg = sm4.get_config()
+    assert cfg["pipeline_parallel"] == 4
+    assert cfg["pipeline_microbatches"] == 4
+
+
+def test_pipeline_parallel_sgd_nesterov_maps(blobs):
+    """SGD+nesterov maps exactly (optax nesterov flag), not silently to
+    heavy-ball momentum."""
+    import keras
+
+    from elephas_tpu.parallel.pipeline_runner import _optax_from_keras
+
+    opt = keras.optimizers.SGD(0.05, momentum=0.9, nesterov=True)
+    tx = _optax_from_keras(opt)
+    import jax.numpy as jnp
+    import optax
+
+    # one step on a quadratic matches optax.sgd(nesterov=True) exactly
+    p = {"w": jnp.ones(3)}
+    g = {"w": jnp.full(3, 0.5)}
+    s1 = tx.init(p)
+    u1, _ = tx.update(g, s1, p)
+    ref = optax.sgd(0.05, momentum=0.9, nesterov=True)
+    s2 = ref.init(p)
+    u2, _ = ref.update(g, s2, p)
+    np.testing.assert_allclose(
+        np.asarray(u1["w"]), np.asarray(u2["w"]), atol=1e-8
+    )
