@@ -57,6 +57,10 @@ def _optax_from_keras(optimizer):
             unsupported.append(attr)
     if getattr(optimizer, "use_ema", False):
         unsupported.append("use_ema")
+    if name != "adamw" and getattr(optimizer, "weight_decay", None):
+        # keras applies decoupled decay on any optimizer; only the adamw
+        # mirror reproduces it
+        unsupported.append("weight_decay")
     if unsupported:
         raise ValueError(
             f"pipeline_parallel: optimizer options {unsupported} have no "
@@ -64,13 +68,20 @@ def _optax_from_keras(optimizer):
             f"parallelism"
         )
     if name == "adam":
-        return optax.adam(
+        make = (
+            optax.amsgrad if getattr(optimizer, "amsgrad", False) else optax.adam
+        )
+        return make(
             lr,
             b1=float(optimizer.beta_1),
             b2=float(optimizer.beta_2),
             eps=float(optimizer.epsilon),
         )
     if name == "adamw":
+        if getattr(optimizer, "amsgrad", False):
+            raise ValueError(
+                "pipeline_parallel: AdamW(amsgrad=True) has no optax mirror"
+            )
         return optax.adamw(
             lr,
             b1=float(optimizer.beta_1),
@@ -91,6 +102,7 @@ def _optax_from_keras(optimizer):
             decay=float(getattr(optimizer, "rho", 0.9)),
             eps=float(optimizer.epsilon),
             momentum=float(getattr(optimizer, "momentum", 0.0) or 0.0),
+            centered=bool(getattr(optimizer, "centered", False)),
         )
     raise ValueError(
         f"pipeline_parallel: no optax mirror for keras optimizer "
@@ -250,8 +262,13 @@ class PipelineRunner:
         return partitions
 
     def run_epochs(self, partitions, epochs, batch_size, verbose=0, callbacks=None):
-        x = np.concatenate([np.asarray(p[0]) for p in partitions])
-        y = np.concatenate([np.asarray(p[1]) for p in partitions])
+        if len(partitions) == 1:
+            # the pipeline consumes whole batches; avoid a second full
+            # host copy of a possibly multi-GB dataset
+            x, y = (np.asarray(partitions[0][0]), np.asarray(partitions[0][1]))
+        else:
+            x = np.concatenate([np.asarray(p[0]) for p in partitions])
+            y = np.concatenate([np.asarray(p[1]) for p in partitions])
         wrapped = None
         if callbacks:
             # callbacks observe the master model (PS publication,

@@ -101,6 +101,13 @@ class SparkModel:
                     f"pipeline_parallel={pipeline_parallel} exceeds the "
                     f"{len(jax.devices())} available devices"
                 )
+            if num_workers is not None and num_workers != self.pipeline_parallel:
+                raise ValueError(
+                    f"num_workers={num_workers} conflicts with "
+                    f"pipeline_parallel={pipeline_parallel}: the pipeline "
+                    f"occupies one device per stage (composing DP around "
+                    f"it is a future extension) — drop num_workers"
+                )
             if self.mode != "synchronous":
                 raise ValueError(
                     "pipeline_parallel trains synchronously (one model, "
@@ -351,12 +358,18 @@ class SparkModel:
                 )
             should_stream = False
         if not should_stream:
-            xs = np.array_split(x, self.num_workers)
-            ys = np.array_split(y, self.num_workers)
-            # fewer rows than workers → empty splits; drop them and let
-            # the runner's partition shaping fill the mesh (same contract
-            # as partition_arrays on the RDD path)
-            partitions = [(a, b) for a, b in zip(xs, ys) if len(a)]
+            if self.pipeline_parallel > 1:
+                # the pipeline consumes whole batches — splitting into
+                # per-worker partitions only to re-concatenate would copy
+                # the dataset
+                partitions = [(x, y)]
+            else:
+                xs = np.array_split(x, self.num_workers)
+                ys = np.array_split(y, self.num_workers)
+                # fewer rows than workers → empty splits; drop them and
+                # let the runner's partition shaping fill the mesh (same
+                # contract as partition_arrays on the RDD path)
+                partitions = [(a, b) for a, b in zip(xs, ys) if len(a)]
             return self._fit_partitions(
                 partitions, epochs, batch_size, verbose, validation_split,
                 **fit_kwargs,

@@ -1,0 +1,67 @@
+"""Pipeline-parallel training via SparkModel — depth sharding demo.
+
+Beyond the reference (SURVEY.md §2a lists pipeline parallelism as
+absent upstream): ``SparkModel(model, pipeline_parallel=S)`` splits a
+compiled ``keras.Sequential`` into parameter-balanced stages, places
+stage ``s`` on device ``s`` of a ``('stages',)`` mesh, and pipelines
+microbatches through a ``lax.ppermute`` ring — models whose LAYERS
+don't fit one chip train through the same L5 surface.
+"""
+
+import argparse
+
+import numpy as np
+
+from elephas_tpu import SparkModel
+from elephas_tpu.data import SparkContext
+from elephas_tpu.utils.rdd_utils import to_simple_rdd
+
+from _datasets import synthetic_mnist, train_test_split
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--stages", type=int, default=2)
+    p.add_argument("--microbatches", type=int, default=4)
+    args = p.parse_args()
+
+    import keras
+
+    (x_train, y_train), (x_test, y_test) = train_test_split(*synthetic_mnist())
+
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((784,)),
+            keras.layers.Dense(256, activation="relu"),
+            keras.layers.Dense(128, activation="relu"),
+            keras.layers.Dense(64, activation="relu"),
+            keras.layers.Dense(10, activation="softmax"),
+        ]
+    )
+    model.compile(
+        optimizer=keras.optimizers.Adam(1e-3),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+
+    sc = SparkContext("local[*]")
+    rdd = to_simple_rdd(sc, x_train, y_train.astype(np.int32))
+    spark_model = SparkModel(
+        model,
+        pipeline_parallel=args.stages,
+        pipeline_microbatches=args.microbatches,
+    )
+    stages = spark_model._get_runner().stage_summary()
+    print(f"{args.stages} pipeline stages: {stages}")
+    history = spark_model.fit(rdd, epochs=args.epochs, batch_size=args.batch_size)
+    print(f"train loss: {[round(v, 4) for v in history['loss']]}")
+
+    results = spark_model.evaluate(x_test, y_test.astype(np.int32))
+    print(f"test loss {results[0]:.4f}  test acc {results[1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

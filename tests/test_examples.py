@@ -39,6 +39,13 @@ def test_hyperparam_optimization():
     run_example("hyperparam_optimization", ["--max-evals", "3", "--epochs", "1"])
 
 
+def test_pipeline_parallel_mlp():
+    run_example(
+        "pipeline_parallel_mlp",
+        ["--epochs", "2", "--stages", "2", "--batch-size", "64"],
+    )
+
+
 def test_long_context_ring():
     run_example(
         "long_context_ring",

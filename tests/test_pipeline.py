@@ -510,11 +510,7 @@ def test_pipeline_parallel_more_guards(blobs):
     h = sm4.fit((x[:256], y[:256]), epochs=1, batch_size=64)
     assert np.isfinite(h["loss"]).all()
 
-    # pipeline_microbatches survives save/load
-    import os
-
-    sm4.save(str(__import__("tempfile").mkdtemp()) + "/pp4.keras")  # noqa
-    # use get_config directly (save/load covered elsewhere)
+    # pipeline_microbatches rides the distribution config
     cfg = sm4.get_config()
     assert cfg["pipeline_parallel"] == 4
     assert cfg["pipeline_microbatches"] == 4
@@ -543,3 +539,42 @@ def test_pipeline_parallel_sgd_nesterov_maps(blobs):
     np.testing.assert_allclose(
         np.asarray(u1["w"]), np.asarray(u2["w"]), atol=1e-8
     )
+
+
+def test_pipeline_parallel_optimizer_option_guards(blobs):
+    """code-review r3: weight_decay on non-adamw raises (keras applies
+    decoupled decay the plain mirrors can't reproduce); num_workers
+    conflicts raise; amsgrad/centered map exactly."""
+    import keras
+    import optax
+
+    from elephas_tpu import SparkModel
+    from elephas_tpu.parallel.pipeline_runner import _optax_from_keras
+
+    x, y, d, k = blobs
+    m = _pp_mlp(d, k)
+    m.compile(
+        optimizer=keras.optimizers.Adam(1e-2, weight_decay=0.01),
+        loss="sparse_categorical_crossentropy",
+    )
+    with pytest.raises(ValueError, match="weight_decay"):
+        SparkModel(m, pipeline_parallel=2).fit((x[:64], y[:64]), epochs=1)
+
+    with pytest.raises(ValueError, match="num_workers"):
+        SparkModel(_pp_mlp(d, k), pipeline_parallel=2, num_workers=8)
+
+    # amsgrad and centered rmsprop map to their optax counterparts
+    tx = _optax_from_keras(keras.optimizers.Adam(1e-3, amsgrad=True))
+    ref = optax.amsgrad(1e-3, eps=1e-7)  # keras's epsilon default
+    import jax.numpy as jnp
+
+    p = {"w": jnp.ones(3)}
+    g = {"w": jnp.full(3, 0.5)}
+    u1, _ = tx.update(g, tx.init(p), p)
+    u2, _ = ref.update(g, ref.init(p), p)
+    np.testing.assert_allclose(np.asarray(u1["w"]), np.asarray(u2["w"]))
+    tx2 = _optax_from_keras(keras.optimizers.RMSprop(1e-3, centered=True))
+    ref2 = optax.rmsprop(1e-3, decay=0.9, eps=1e-7, centered=True)
+    u3, _ = tx2.update(g, tx2.init(p), p)
+    u4, _ = ref2.update(g, ref2.init(p), p)
+    np.testing.assert_allclose(np.asarray(u3["w"]), np.asarray(u4["w"]))
