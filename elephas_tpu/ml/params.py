@@ -128,6 +128,12 @@ HasModelParallel = _mixin(
     1,
     cap="ModelParallel",
 )
+HasPipelineParallel = _mixin(
+    "pipeline_parallel",
+    "Pipeline stages (keras.Sequential depth sharding); 1 = off.",
+    1,
+    cap="PipelineParallel",
+)
 HasEpochs = _mixin("epochs", "Training epochs.", 10)
 HasBatchSize = _mixin("batch_size", "Per-worker batch size.", 32, cap="BatchSize")
 HasVerbosity = _mixin("verbose", "Verbosity 0/1/2.", 0, cap="Verbosity")

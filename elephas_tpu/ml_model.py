@@ -38,6 +38,7 @@ from elephas_tpu.ml.params import (
     HasMetrics,
     HasMode,
     HasModelParallel,
+    HasPipelineParallel,
     HasNumberOfClasses,
     HasNumberOfWorkers,
     HasOptimizerConfig,
@@ -56,6 +57,7 @@ class _ElephasParams(
     HasFrequency,
     HasNumberOfWorkers,
     HasModelParallel,
+    HasPipelineParallel,
     HasEpochs,
     HasBatchSize,
     HasVerbosity,
@@ -130,6 +132,7 @@ class ElephasEstimator(_ElephasParams):
             custom_objects=config["custom_objects"],
             batch_size=config["batch_size"],
             model_parallel=config.get("model_parallel", 1),
+            pipeline_parallel=config.get("pipeline_parallel", 1),
         )
         spark_model.fit(
             rdd,

@@ -24,6 +24,11 @@ Inference/evaluate run data-parallel through a
 :class:`~elephas_tpu.worker.MeshRunner` after the trained stage weights
 write back into the master model: PP pays off in training (activations
 + optimizer state); forward-only fits one chip whenever the weights do.
+
+The training history is loss-only (threading metric state through the
+ring would put metric updates on the last stage's critical path); use
+``fit(validation_split=...)`` for per-epoch ``val_*`` metrics — they
+run through the data-parallel evaluator.
 """
 
 from __future__ import annotations
@@ -67,21 +72,23 @@ def _optax_from_keras(optimizer):
             f"optax mirror here — remove them or use data/model "
             f"parallelism"
         )
-    if name == "adam":
-        make = (
-            optax.amsgrad if getattr(optimizer, "amsgrad", False) else optax.adam
+    if name in ("adam", "adamw") and getattr(optimizer, "amsgrad", False):
+        # optax.amsgrad maxes BIAS-CORRECTED second moments; keras maxes
+        # the raw ones before correction — the two diverge from step 2,
+        # so there is no exact mirror
+        raise ValueError(
+            "pipeline_parallel: amsgrad=True has no exact optax mirror "
+            "(keras maxes raw second moments, optax maxes bias-corrected "
+            "ones) — disable amsgrad or use data/model parallelism"
         )
-        return make(
+    if name == "adam":
+        return optax.adam(
             lr,
             b1=float(optimizer.beta_1),
             b2=float(optimizer.beta_2),
             eps=float(optimizer.epsilon),
         )
     if name == "adamw":
-        if getattr(optimizer, "amsgrad", False):
-            raise ValueError(
-                "pipeline_parallel: AdamW(amsgrad=True) has no optax mirror"
-            )
         return optax.adamw(
             lr,
             b1=float(optimizer.beta_1),

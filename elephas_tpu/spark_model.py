@@ -295,10 +295,16 @@ class SparkModel:
                 stream_block_steps=stream_block_steps,
                 history_log=history_log,
             )
-        if not rdd.is_lazy() and rdd.getNumPartitions() != self.num_workers:
+        if (
+            not rdd.is_lazy()
+            and self.pipeline_parallel <= 1
+            and rdd.getNumPartitions() != self.num_workers
+        ):
             # lazy RDDs skip the element-wise repartition (it would
             # materialize row-by-row); the runner's partition shaping
-            # re-splits the ranged reads to the mesh instead
+            # re-splits the ranged reads to the mesh instead. Pipeline
+            # stages are depth shards, not data shards — repartitioning
+            # for them would just shuffle rows to re-concatenate.
             rdd = rdd.repartition(self.num_workers)
         partitions = rdd_utils.partition_arrays(rdd)
         return self._fit_partitions(

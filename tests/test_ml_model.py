@@ -198,3 +198,48 @@ def test_estimator_model_parallel_param(blobs):
     transformer = est.fit(df)
     out = transformer.transform(df)
     assert "prediction" in out.columns
+
+
+def test_estimator_pipeline_parallel_param(blobs):
+    """r3: the pipeline surface reaches PP too — model_from_json of a
+    Sequential reconstructs a Sequential, so depth sharding works from
+    the string-keyed config."""
+    import json
+
+    import keras
+
+    from elephas_tpu.data.dataframe import SparkSession
+    from elephas_tpu.ml_model import ElephasEstimator
+
+    x, y, d, k = blobs
+    keras.utils.set_random_seed(53)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((d,)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(k, activation="softmax"),
+        ]
+    )
+    session = SparkSession()
+    df = session.createDataFrame(
+        [(row, float(label)) for row, label in zip(x[:320], y[:320])],
+        schema=["features", "label"],
+    )
+    est = ElephasEstimator(
+        keras_model_config=model.to_json(),
+        optimizer_config=json.dumps(
+            keras.optimizers.serialize(keras.optimizers.Adam(1e-2))
+        ),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+        epochs=3,
+        batch_size=32,
+        pipeline_parallel=2,
+        categorical_labels=False,
+        nb_classes=k,
+    )
+    assert est.getPipelineParallel() == 2
+    transformer = est.fit(df)
+    out = transformer.transform(df)
+    assert "prediction" in out.columns
