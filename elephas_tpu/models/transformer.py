@@ -198,24 +198,35 @@ def transformer_lm(
     dropout: float = 0.0,
     lr: float = 3e-4,
     seed: int = 0,
+    dtype_policy: str | None = None,
 ):
-    """Decoder-only causal LM (next-token prediction)."""
+    """Decoder-only causal LM (next-token prediction).
+
+    ``dtype_policy='mixed_bfloat16'`` keeps the matmuls (and the flash
+    attention kernel) in bf16 on the MXU; the lm_head logits stay f32."""
     keras = _keras()
     keras.utils.set_random_seed(seed)
-    L = keras.layers
-    FlashMHA = _flash_mha_layer()
-    head_dim = d_model // num_heads
+    prev_policy = keras.config.dtype_policy()
+    if dtype_policy is not None:
+        keras.config.set_dtype_policy(dtype_policy)
+    try:
+        L = keras.layers
+        FlashMHA = _flash_mha_layer()
+        head_dim = d_model // num_heads
 
-    inputs = keras.Input((maxlen,), dtype="int32")
-    x = L.Embedding(vocab_size, d_model, name="tok_embed")(inputs)
-    x = x + _positions(maxlen, d_model)[None]
-    for b in range(num_layers):
-        x = _block(
-            x, num_heads, head_dim, mlp_ratio, dropout, True, f"blk{b}", L, FlashMHA
-        )
-    x = L.LayerNormalization(epsilon=1e-6, name="final_ln")(x)
-    outputs = L.Dense(vocab_size, name="lm_head")(x)
-    model = keras.Model(inputs, outputs, name="transformer_lm")
+        inputs = keras.Input((maxlen,), dtype="int32")
+        x = L.Embedding(vocab_size, d_model, name="tok_embed")(inputs)
+        x = x + _positions(maxlen, d_model)[None]
+        for b in range(num_layers):
+            x = _block(
+                x, num_heads, head_dim, mlp_ratio, dropout, True,
+                f"blk{b}", L, FlashMHA,
+            )
+        x = L.LayerNormalization(epsilon=1e-6, name="final_ln")(x)
+        outputs = L.Dense(vocab_size, name="lm_head", dtype="float32")(x)
+        model = keras.Model(inputs, outputs, name="transformer_lm")
+    finally:
+        keras.config.set_dtype_policy(prev_policy)
     model.compile(
         optimizer=keras.optimizers.Adam(lr),
         loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),

@@ -665,6 +665,9 @@ class ShardedTrainer(KerasIntrospection):
     # -- predict ---------------------------------------------------------
 
     def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        """Batched forward pass (fixed-shape batches wrap-pad, so one
+        compiled program serves any input size — and a beyond-HBM eval
+        set never stages at once)."""
         model = self.model
         if self._predict_fn is None:
             def forward(tv, ntv, x):
@@ -678,14 +681,26 @@ class ShardedTrainer(KerasIntrospection):
         dp = self.dp
         x = np.asarray(x)
         n = len(x)
-        pad = (-n) % dp
-        if pad:
-            # repeat the last row — safe even when n < pad
-            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)])
-        out = np.asarray(
-            jax.device_get(self._predict_fn(tv, ntv, jax.device_put(x, self._data_sh)))
-        )
-        return out[:n]
+        if n == 0:
+            raise ValueError("predict: no input rows")
+        batch_size = max(dp, (batch_size // dp) * dp)
+        nb = max(1, int(np.ceil(n / batch_size)))
+        idx = np.arange(nb * batch_size) % n
+        outs = []
+        for b in range(nb):
+            rows = idx[b * batch_size : (b + 1) * batch_size]
+            # fetch inside the loop: async dispatch would otherwise keep
+            # every batch's input+output resident in HBM at once
+            outs.append(
+                np.asarray(
+                    jax.device_get(
+                        self._predict_fn(
+                            tv, ntv, jax.device_put(x[rows], self._data_sh)
+                        )
+                    )
+                )
+            )
+        return np.concatenate(outs)[:n]
 
     # -- sharded checkpointing -------------------------------------------
 

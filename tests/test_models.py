@@ -148,3 +148,21 @@ def test_remat_transformer_trains():
     history = sm.fit((x, y), epochs=2, batch_size=16)
     assert np.isfinite(history["loss"]).all()
     assert history["loss"][-1] < history["loss"][0]
+
+
+def test_transformer_lm_bf16_builds_and_steps():
+    import numpy as np
+
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import transformer_lm
+
+    model = transformer_lm(
+        vocab_size=128, maxlen=16, d_model=32, num_heads=2, num_layers=1,
+        dtype_policy="mixed_bfloat16", seed=5,
+    )
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 128, size=(64, 16)).astype(np.int32)
+    y = np.roll(x, -1, axis=1)
+    sm = SparkModel(model, num_workers=8)
+    h = sm.fit((x, y), epochs=1, batch_size=16)
+    assert np.isfinite(h["loss"]).all()
