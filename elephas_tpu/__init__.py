@@ -73,6 +73,7 @@ __all__ = [
     "HyperParamModel",
     "ShardedTrainer",
     "GPipeTrainer",
+    "SequenceShardedTrainer",
     "__version__",
 ]
 
@@ -88,4 +89,8 @@ def __getattr__(name):
         from elephas_tpu.ops.pipeline import GPipeTrainer
 
         return GPipeTrainer
+    if name == "SequenceShardedTrainer":
+        from elephas_tpu.parallel.sequence import SequenceShardedTrainer
+
+        return SequenceShardedTrainer
     raise AttributeError(name)

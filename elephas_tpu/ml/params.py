@@ -134,6 +134,12 @@ HasPipelineParallel = _mixin(
     1,
     cap="PipelineParallel",
 )
+HasSequenceParallel = _mixin(
+    "sequence_parallel",
+    "Seq-axis size of the ('data','seq') mesh (ring attention); 1 = off.",
+    1,
+    cap="SequenceParallel",
+)
 HasEpochs = _mixin("epochs", "Training epochs.", 10)
 HasBatchSize = _mixin("batch_size", "Per-worker batch size.", 32, cap="BatchSize")
 HasVerbosity = _mixin("verbose", "Verbosity 0/1/2.", 0, cap="Verbosity")

@@ -39,6 +39,7 @@ from elephas_tpu.ml.params import (
     HasMode,
     HasModelParallel,
     HasPipelineParallel,
+    HasSequenceParallel,
     HasNumberOfClasses,
     HasNumberOfWorkers,
     HasOptimizerConfig,
@@ -58,6 +59,7 @@ class _ElephasParams(
     HasNumberOfWorkers,
     HasModelParallel,
     HasPipelineParallel,
+    HasSequenceParallel,
     HasEpochs,
     HasBatchSize,
     HasVerbosity,
@@ -133,6 +135,7 @@ class ElephasEstimator(_ElephasParams):
             batch_size=config["batch_size"],
             model_parallel=config.get("model_parallel", 1),
             pipeline_parallel=config.get("pipeline_parallel", 1),
+            sequence_parallel=config.get("sequence_parallel", 1),
         )
         spark_model.fit(
             rdd,

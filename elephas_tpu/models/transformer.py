@@ -20,6 +20,8 @@ as absent upstream — TPU-native extension, not a port).
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 
@@ -27,6 +29,19 @@ def _keras():
     import keras
 
     return keras
+
+
+@contextlib.contextmanager
+def _dtype_policy_scope(keras, policy: str | None):
+    """Temporarily set Keras's global dtype policy while building layers
+    (restored even on build failure — the global must not leak)."""
+    prev = keras.config.dtype_policy()
+    if policy is not None:
+        keras.config.set_dtype_policy(policy)
+    try:
+        yield
+    finally:
+        keras.config.set_dtype_policy(prev)
 
 
 _FLASH_MHA_CLS = None
@@ -74,6 +89,10 @@ def _flash_mha_layer():
         def call(self, x):
             import jax.numpy as jnp
 
+            from elephas_tpu.parallel.sequence import (
+                active_sequence_scope, ring_mha,
+            )
+
             B = jnp.shape(x)[0]
             S = x.shape[1]
             H, D = self.num_heads, self.head_dim
@@ -81,7 +100,14 @@ def _flash_mha_layer():
             qkv = jnp.reshape(qkv, (B, S, 3, H, D))
             qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3, B, H, S, D]
             q, k, v = qkv[0], qkv[1], qkv[2]
-            out = flash_attention(q, k, v, causal=self.causal)  # [B, H, S, D]
+            scope = active_sequence_scope()
+            if scope is not None:
+                # sequence-parallel region: the S axis is sharded over
+                # the mesh — ring the KV shards instead of running the
+                # single-chip flash kernel on a gathered sequence
+                out = ring_mha(q, k, v, causal=self.causal, scope=scope)
+            else:
+                out = flash_attention(q, k, v, causal=self.causal)
             out = jnp.reshape(jnp.transpose(out, (0, 2, 1, 3)), (B, S, H * D))
             return self.proj(out)
 
@@ -152,10 +178,7 @@ def transformer_classifier(
     attention kernel) in bf16 on the MXU with float32 variables."""
     keras = _keras()
     keras.utils.set_random_seed(seed)
-    prev_policy = keras.config.dtype_policy()
-    if dtype_policy is not None:
-        keras.config.set_dtype_policy(dtype_policy)
-    try:
+    with _dtype_policy_scope(keras, dtype_policy):
         L = keras.layers
         FlashMHA = _flash_mha_layer()
         head_dim = d_model // num_heads
@@ -175,8 +198,6 @@ def transformer_classifier(
             num_classes, activation=activation, name="head", dtype="float32"
         )(x)
         model = keras.Model(inputs, outputs, name="transformer_classifier")
-    finally:
-        keras.config.set_dtype_policy(prev_policy)
     loss = (
         "binary_crossentropy"
         if num_classes == 1
@@ -206,10 +227,7 @@ def transformer_lm(
     attention kernel) in bf16 on the MXU; the lm_head logits stay f32."""
     keras = _keras()
     keras.utils.set_random_seed(seed)
-    prev_policy = keras.config.dtype_policy()
-    if dtype_policy is not None:
-        keras.config.set_dtype_policy(dtype_policy)
-    try:
+    with _dtype_policy_scope(keras, dtype_policy):
         L = keras.layers
         FlashMHA = _flash_mha_layer()
         head_dim = d_model // num_heads
@@ -225,8 +243,6 @@ def transformer_lm(
         x = L.LayerNormalization(epsilon=1e-6, name="final_ln")(x)
         outputs = L.Dense(vocab_size, name="lm_head", dtype="float32")(x)
         model = keras.Model(inputs, outputs, name="transformer_lm")
-    finally:
-        keras.config.set_dtype_policy(prev_policy)
     model.compile(
         optimizer=keras.optimizers.Adam(lr),
         loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),

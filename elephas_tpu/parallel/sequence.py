@@ -1,0 +1,209 @@
+"""Sequence/context parallelism behind the parity API.
+
+The reference trains sequence models whole-sequence per worker — its
+sequence length is bounded by one worker's memory (SURVEY.md §5
+"long-context: entirely absent"). This module removes that ceiling the
+TPU way: the sequence axis of every activation is sharded over a
+``('data', 'seq')`` mesh, attention runs as a **ring** —
+:func:`elephas_tpu.ops.ring_attention.ring_attention` rotates KV shards
+via ``ppermute`` over ICI while queries stay put — and every other op
+(layernorm, MLP, embedding lookup) is token-local, so GSPMD runs it on
+the sequence shards with no communication at all.
+
+Design: weights replicate (``rules=[]`` under the
+:class:`~elephas_tpu.parallel.tensor.ShardedTrainer` machinery — the
+planner is told to shard *nothing*), activations shard. The only manual
+region is the attention core: :class:`~elephas_tpu.models.transformer`'s
+``FlashMHA`` layer consults :func:`active_sequence_scope` at trace time
+and, inside a sequence-parallel region, routes through a ``shard_map``
+ring instead of the single-chip Pallas flash kernel. Everything else —
+fit/evaluate/predict/history metrics/sharded checkpoints — is inherited
+from the tensor-parallel trainer unchanged.
+
+``SparkModel(model, sequence_parallel=N)`` routes here via
+:class:`SequenceParallelRunner`; data-parallel replicas occupy the
+remaining ``devices // N`` mesh rows, so DP×SP composes on one mesh.
+
+No counterpart exists upstream (TPU-native extension, not a port).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from elephas_tpu.parallel.tensor import ShardedTrainer, TensorParallelRunner
+
+logger = logging.getLogger(__name__)
+
+# (mesh, data_axis, seq_axis) while a sequence-parallel trainer is
+# tracing/running — read by FlashMHA.call. Thread-local so concurrent
+# trainers (hyperparam trials run threads) can't see each other's mesh.
+_SCOPE = threading.local()
+
+
+class _SequenceScope:
+    __slots__ = ("mesh", "data_axis", "seq_axis")
+
+    def __init__(self, mesh: Mesh, data_axis: str, seq_axis: str):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.seq_axis = seq_axis
+
+
+def active_sequence_scope() -> _SequenceScope | None:
+    """The innermost active sequence-parallel scope, or None."""
+    stack = getattr(_SCOPE, "stack", None)
+    return stack[-1] if stack else None
+
+
+class sequence_parallel_scope:
+    """Context manager: route sequence-aware ops (``FlashMHA``) through
+    the ring over ``mesh[seq_axis]`` for the duration."""
+
+    def __init__(self, mesh: Mesh, data_axis: str = "data",
+                 seq_axis: str = "seq"):
+        self._scope = _SequenceScope(mesh, data_axis, seq_axis)
+
+    def __enter__(self):
+        if not hasattr(_SCOPE, "stack"):
+            _SCOPE.stack = []
+        _SCOPE.stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _SCOPE.stack.pop()
+        return False
+
+
+def dp_sp_mesh(sequence_parallel: int, data_parallel: int | None = None) -> Mesh:
+    """2-D ``('data', 'seq')`` mesh — see
+    :func:`~elephas_tpu.parallel.tensor.second_axis_mesh`."""
+    from elephas_tpu.parallel.tensor import second_axis_mesh
+
+    return second_axis_mesh(
+        sequence_parallel, "seq", data_parallel, label="sequence_parallel"
+    )
+
+
+def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
+             scope: _SequenceScope | None = None):
+    """Ring attention on ``[B, H, S, D]`` heads under the active scope.
+
+    Batch·heads shard over the data axis, sequence over the seq axis;
+    KV shards rotate the ring (``ops/ring_attention.py``). Gradients
+    flow (the ring op carries a custom VJP)."""
+    from elephas_tpu.ops.ring_attention import ring_attention
+
+    scope = scope or active_sequence_scope()
+    if scope is None:
+        raise RuntimeError(
+            "ring_mha called outside a sequence_parallel_scope"
+        )
+    b, h, s, d = q.shape
+    sp = scope.mesh.shape[scope.seq_axis]
+    dp = scope.mesh.shape[scope.data_axis]
+    if s % sp:
+        raise ValueError(
+            f"sequence length {s} must divide over sequence_parallel={sp}"
+        )
+    # batch·heads shards over 'data' when it tiles; otherwise (tiny
+    # introspection batches, 1-row predict) it replicates — the ring
+    # only needs the seq axis, so this is a layout choice, not a limit
+    data_axis = scope.data_axis if (b * h) % dp == 0 else None
+    spec = P(data_axis, scope.seq_axis, None)
+    fn = functools.partial(
+        ring_attention, axis_name=scope.seq_axis, causal=causal, scale=scale
+    )
+    sharded = jax.shard_map(
+        fn, mesh=scope.mesh, in_specs=(spec,) * 3, out_specs=spec,
+        check_vma=False,
+    )
+    out = sharded(
+        q.reshape(b * h, s, d), k.reshape(b * h, s, d), v.reshape(b * h, s, d)
+    )
+    return out.reshape(b, h, s, d)
+
+
+class SequenceShardedTrainer(ShardedTrainer):
+    """DP×SP trainer for a compiled Keras model whose attention layers
+    are sequence-aware (``FlashMHA``).
+
+    Weights replicate; the sequence axis of activations shards over the
+    ``seq`` mesh axis (GSPMD propagates the layout out of the attention
+    ``shard_map`` through the token-local ops). Training is synchronous
+    — the ``seq`` shards jointly compute ONE model's step, and the
+    ``data`` axis all-reduces gradients per step; async/hogwild describe
+    diverging data replicas and do not apply to a sequence split.
+    """
+
+    MODEL_AXIS = "seq"
+
+    def __init__(
+        self,
+        model,
+        sequence_parallel: int = 1,
+        mesh: Mesh | None = None,
+        data_parallel: int | None = None,
+    ):
+        mesh = mesh if mesh is not None else dp_sp_mesh(
+            sequence_parallel, data_parallel
+        )
+        super().__init__(
+            model, mesh=mesh, rules=[], mode="synchronous", frequency="epoch"
+        )
+        self.sp = self.mesh.shape["seq"]
+        if not self._has_sequence_aware_layer(model):
+            logger.warning(
+                "sequence_parallel=%d but the model has no sequence-aware "
+                "attention layer (FlashMHA) — training stays correct, but "
+                "nothing rings over the seq axis; activations may simply "
+                "replicate across it",
+                self.sp,
+            )
+
+    @staticmethod
+    def _has_sequence_aware_layer(model) -> bool:
+        from elephas_tpu.models.transformer import _flash_mha_layer
+
+        cls = _flash_mha_layer()
+        return any(isinstance(l, cls) for l in model._flatten_layers())
+
+    def _scope(self):
+        return sequence_parallel_scope(self.mesh, "data", "seq")
+
+    # every public entry point runs (and, on first call, TRACES) inside
+    # the scope, so FlashMHA sees the mesh whenever jit retraces
+    def fit(self, *args, **kwargs):
+        with self._scope():
+            return super().fit(*args, **kwargs)
+
+    def fit_stream(self, *args, **kwargs):
+        with self._scope():
+            return super().fit_stream(*args, **kwargs)
+
+    def evaluate(self, *args, **kwargs):
+        with self._scope():
+            return super().evaluate(*args, **kwargs)
+
+    def predict(self, *args, **kwargs):
+        with self._scope():
+            return super().predict(*args, **kwargs)
+
+
+class SequenceParallelRunner(TensorParallelRunner):
+    """``MeshRunner``-shaped facade so ``SparkModel(model,
+    sequence_parallel=N)`` drives the whole L5 surface
+    (fit/evaluate/predict/checkpoint/streaming) over the DP×SP mesh."""
+
+    def __init__(self, model, mesh: Mesh):
+        self.model = model
+        self.mode = "synchronous"
+        self.frequency = "epoch"
+        self.mesh = mesh
+        self.num_workers = mesh.shape["data"]
+        self.trainer = SequenceShardedTrainer(model, mesh=mesh)

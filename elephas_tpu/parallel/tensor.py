@@ -68,28 +68,39 @@ DEFAULT_RULES: list[tuple[str, callable]] = [
 ]
 
 
-def dp_tp_mesh(model_parallel: int = 1, data_parallel: int | None = None) -> Mesh:
-    """2-D mesh over the addressable devices: ``('data', 'model')``.
+def second_axis_mesh(
+    n: int, axis_name: str, data_parallel: int | None = None,
+    label: str | None = None,
+) -> Mesh:
+    """2-D ``('data', <axis_name>)`` mesh over the addressable devices.
 
     With explicit ``data_parallel`` the mesh is the leading
-    ``dp×mp``-device submesh (divisibility of the full device count is
+    ``dp×n``-device submesh (divisibility of the full device count is
     not required — 2×3 on 8 devices is a valid 6-device mesh)."""
+    label = label or f"{axis_name}_parallel"
     devices = jax.devices()
-    if model_parallel <= 0:
-        raise ValueError(f"model_parallel must be positive, got {model_parallel}")
-    if data_parallel is None and len(devices) % model_parallel:
+    if n <= 0:
+        raise ValueError(f"{label} must be positive, got {n}")
+    if data_parallel is None and len(devices) % n:
         raise ValueError(
-            f"model_parallel={model_parallel} must divide the device count "
+            f"{label}={n} must divide the device count "
             f"({len(devices)}) — or pass data_parallel explicitly"
         )
-    dp = data_parallel or len(devices) // model_parallel
-    if dp * model_parallel > len(devices):
+    dp = data_parallel or len(devices) // n
+    if dp * n > len(devices):
         raise ValueError(
-            f"data_parallel×model_parallel = {dp}×{model_parallel} exceeds "
+            f"data_parallel×{label} = {dp}×{n} exceeds "
             f"{len(devices)} devices"
         )
-    arr = np.array(devices[: dp * model_parallel]).reshape(dp, model_parallel)
-    return Mesh(arr, ("data", "model"))
+    arr = np.array(devices[: dp * n]).reshape(dp, n)
+    return Mesh(arr, ("data", axis_name))
+
+
+def dp_tp_mesh(model_parallel: int = 1, data_parallel: int | None = None) -> Mesh:
+    """2-D ``('data', 'model')`` mesh — see :func:`second_axis_mesh`."""
+    return second_axis_mesh(
+        model_parallel, "model", data_parallel, label="model_parallel"
+    )
 
 
 def plan_sharding(
@@ -129,7 +140,9 @@ def plan_sharding(
                     )
                 break
         out.append(NamedSharding(mesh, spec))
-    if axis_size > 1 and variables and all(s.spec == P() for s in out):
+    # rules=[] is an explicit everything-replicates request (sequence
+    # parallelism shards activations, not weights) — no warning there
+    if rules and axis_size > 1 and variables and all(s.spec == P() for s in out):
         biggest = sorted(
             variables, key=lambda v: -int(np.prod(v.shape))
         )[:3]
@@ -152,6 +165,10 @@ class ShardedTrainer(KerasIntrospection):
     ``model`` axis rather than replicated per worker.
     """
 
+    # second mesh-axis name; subclasses repurpose the machinery over a
+    # differently-named axis (sequence parallelism uses 'seq')
+    MODEL_AXIS = "model"
+
     def __init__(
         self,
         model,
@@ -173,9 +190,13 @@ class ShardedTrainer(KerasIntrospection):
         self.mode = mode
         self.frequency = frequency
         self.mesh = mesh or dp_tp_mesh(model_parallel)
-        if "data" not in self.mesh.shape or "model" not in self.mesh.shape:
+        if (
+            "data" not in self.mesh.shape
+            or self.MODEL_AXIS not in self.mesh.shape
+        ):
             raise ValueError(
-                f"mesh must have ('data', 'model') axes, got {self.mesh.shape}"
+                f"mesh must have ('data', {self.MODEL_AXIS!r}) axes, "
+                f"got {self.mesh.shape}"
             )
         # per-replica weights (local-SGD semantics) for the modes whose
         # replicas must diverge between sync points; single-copy GSPMD
@@ -183,9 +204,13 @@ class ShardedTrainer(KerasIntrospection):
         self.per_replica = mode != "synchronous" or frequency == "fit"
         self.dp = self.mesh.shape["data"]
         model.optimizer.build(model.trainable_variables)
-        self._tv_sh = plan_sharding(model.trainable_variables, self.mesh, rules=rules)
+        self._tv_sh = plan_sharding(
+            model.trainable_variables, self.mesh,
+            model_axis=self.MODEL_AXIS, rules=rules,
+        )
         self._ntv_sh = plan_sharding(
-            model.non_trainable_variables, self.mesh, rules=rules
+            model.non_trainable_variables, self.mesh,
+            model_axis=self.MODEL_AXIS, rules=rules,
         )
         # optimizer slots mirror their parameter's layout when shapes match
         # (adam m/v etc.); scalar counters replicate
@@ -571,6 +596,18 @@ class ShardedTrainer(KerasIntrospection):
 
     # -- evaluate --------------------------------------------------------
 
+    def _wrap_pad_indices(self, n: int, batch_size: int, what: str):
+        """Fixed-shape batching for evaluate/predict: round ``batch_size``
+        down to a multiple of the data axis, wrap-pad row indices so every
+        batch has the full jit shape. Returns ``(batch_size, nb, idx)``;
+        positions ``>= n`` are wrapped repeats (mask or trim them)."""
+        if n == 0:
+            raise ValueError(f"{what}: no input rows")
+        batch_size = max(self.dp, (batch_size // self.dp) * self.dp)
+        nb = int(np.ceil(n / batch_size))
+        idx = np.arange(nb * batch_size) % n
+        return batch_size, nb, idx
+
     def _build_eval_step(self, metric_objects, loss_keys):
         model = self.model
         per_sample_loss = self._per_sample_loss_fn()
@@ -621,13 +658,8 @@ class ShardedTrainer(KerasIntrospection):
         metrics). ``y`` may be a list/tuple for multi-output models."""
         x = np.asarray(x)
         n = len(x)
-        if n == 0:
-            raise ValueError("evaluate: no input rows")
-        dp = self.dp
-        batch_size = max(dp, (batch_size // dp) * dp)
-        nb = max(1, int(np.ceil(n / batch_size)))
+        batch_size, nb, idx = self._wrap_pad_indices(n, batch_size, "evaluate")
         total = nb * batch_size
-        idx = np.arange(total) % n
         w = (np.arange(total) < n).astype(np.float32)
         xb = x[idx].reshape((nb, batch_size) + x.shape[1:])
         yb = jax.tree.map(
@@ -678,14 +710,9 @@ class ShardedTrainer(KerasIntrospection):
                 forward, in_shardings=(self._tv_sh, self._ntv_sh, self._data_sh)
             )
         tv, ntv = self._eval_state()
-        dp = self.dp
         x = np.asarray(x)
         n = len(x)
-        if n == 0:
-            raise ValueError("predict: no input rows")
-        batch_size = max(dp, (batch_size // dp) * dp)
-        nb = max(1, int(np.ceil(n / batch_size)))
-        idx = np.arange(nb * batch_size) % n
+        batch_size, nb, idx = self._wrap_pad_indices(n, batch_size, "predict")
         outs = []
         for b in range(nb):
             rows = idx[b * batch_size : (b + 1) * batch_size]

@@ -48,6 +48,7 @@ class SparkModel:
         model_parallel: int = 1,
         pipeline_parallel: int = 1,
         pipeline_microbatches: int = 4,
+        sequence_parallel: int = 1,
         *args,
         **kwargs,
     ):
@@ -85,13 +86,23 @@ class SparkModel:
         self.model_parallel = int(model_parallel)
         self.pipeline_parallel = int(pipeline_parallel)
         self.pipeline_microbatches = int(pipeline_microbatches)
+        self.sequence_parallel = int(sequence_parallel)
         self.kwargs = kwargs
 
-        if self.model_parallel > 1 and self.pipeline_parallel > 1:
+        active = [
+            name
+            for name, n in (
+                ("model_parallel", self.model_parallel),
+                ("pipeline_parallel", self.pipeline_parallel),
+                ("sequence_parallel", self.sequence_parallel),
+            )
+            if n > 1
+        ]
+        if len(active) > 1:
             raise ValueError(
-                "model_parallel and pipeline_parallel are separate "
-                "strategies here — pick one (composing them is a future "
-                "extension)"
+                f"{' and '.join(active)} are separate strategies here — "
+                f"pick one (composing them is a future extension; each "
+                f"already composes with data parallelism)"
             )
         if self.pipeline_parallel > 1:
             import jax
@@ -133,14 +144,37 @@ class SparkModel:
 
             import jax
 
-            max_dp = len(jax.devices()) // self.model_parallel
-            if max_dp < 1:
+            self.mesh = self._dp_submesh(
+                self.model_parallel, "model_parallel", dp_tp_mesh,
+                num_workers, jax,
+            )
+            self.num_workers = self.mesh.shape["data"]
+        elif self.sequence_parallel > 1:
+            # sequences longer than one chip's memory: 2-D ('data',
+            # 'seq') mesh — attention rings KV shards over the seq axis
+            # (SURVEY.md §5 long-context row; TPU-native extension)
+            from elephas_tpu.parallel.sequence import dp_sp_mesh
+
+            import jax
+
+            if self.mode != "synchronous":
                 raise ValueError(
-                    f"model_parallel={model_parallel} exceeds the "
-                    f"{len(jax.devices())} available devices"
+                    "sequence_parallel trains synchronously (the seq "
+                    "shards jointly compute one model's step); "
+                    "asynchronous/hogwild modes apply to data-parallel "
+                    "replicas"
                 )
-            dp = min(num_workers, max_dp) if num_workers else max_dp
-            self.mesh = dp_tp_mesh(self.model_parallel, data_parallel=dp)
+            if self.frequency == "fit":
+                raise ValueError(
+                    "frequency='fit' selects per-replica local-SGD "
+                    "semantics, which don't apply under "
+                    "sequence_parallel (synchronous per-step training; "
+                    "use frequency='epoch')"
+                )
+            self.mesh = self._dp_submesh(
+                self.sequence_parallel, "sequence_parallel", dp_sp_mesh,
+                num_workers, jax,
+            )
             self.num_workers = self.mesh.shape["data"]
         else:
             self.mesh = worker_mesh(num_workers)
@@ -148,6 +182,20 @@ class SparkModel:
         self._runner = None
         self._parameter_server = None
         self.training_histories: list[dict] = []
+
+    @staticmethod
+    def _dp_submesh(parallel_n, label, build_mesh, num_workers, jax):
+        """2-D ``('data', <axis>)`` mesh for a model/sequence-parallel
+        strategy: the second axis gets ``parallel_n`` devices, data
+        replicas fill the rest (capped by ``num_workers`` if given)."""
+        max_dp = len(jax.devices()) // parallel_n
+        if max_dp < 1:
+            raise ValueError(
+                f"{label}={parallel_n} exceeds the "
+                f"{len(jax.devices())} available devices"
+            )
+        dp = min(num_workers, max_dp) if num_workers else max_dp
+        return build_mesh(parallel_n, data_parallel=dp)
 
     # -- properties ----------------------------------------------------
 
@@ -171,6 +219,7 @@ class SparkModel:
             "model_parallel": self.model_parallel,
             "pipeline_parallel": self.pipeline_parallel,
             "pipeline_microbatches": self.pipeline_microbatches,
+            "sequence_parallel": self.sequence_parallel,
         }
 
     # -- parameter server (API parity; see module docstring) -----------
@@ -630,6 +679,14 @@ class SparkModel:
                 self._runner = TensorParallelRunner(
                     self._master_network, self.mode, self.frequency, self.mesh
                 )
+            elif self.sequence_parallel > 1:
+                from elephas_tpu.parallel.sequence import (
+                    SequenceParallelRunner,
+                )
+
+                self._runner = SequenceParallelRunner(
+                    self._master_network, self.mesh
+                )
             else:
                 self._runner = MeshRunner(
                     self._master_network, self.mode, self.frequency, self.mesh
@@ -686,4 +743,5 @@ def load_spark_model(file_name: str) -> SparkModel:
         model_parallel=config.get("model_parallel", 1),
         pipeline_parallel=config.get("pipeline_parallel", 1),
         pipeline_microbatches=config.get("pipeline_microbatches", 4),
+        sequence_parallel=config.get("sequence_parallel", 1),
     )
