@@ -116,6 +116,31 @@ def gpipe_sharded(
     return out.reshape((b,) + out.shape[2:])
 
 
+def pipeline_mesh(
+    num_stages: int,
+    data_parallel: int = 1,
+    axis_name: str = "stages",
+    data_axis: str = "data",
+) -> Mesh:
+    """Mesh for a (possibly data-replicated) pipeline: 1-D
+    ``('stages',)`` when ``data_parallel == 1``, else a
+    ``(data_parallel, num_stages)`` grid ``('data', 'stages')`` — each
+    data row runs its own activation ring."""
+    dp = int(data_parallel)
+    devices = jax.devices()
+    if len(devices) < num_stages * dp:
+        raise ValueError(
+            f"{num_stages} stages × {dp} data replicas need "
+            f"{num_stages * dp} devices, have {len(devices)}"
+        )
+    if dp > 1:
+        return Mesh(
+            np.array(devices[: dp * num_stages]).reshape(dp, num_stages),
+            (data_axis, axis_name),
+        )
+    return Mesh(np.array(devices[:num_stages]), (axis_name,))
+
+
 class GPipeTrainer:
     """Microbatched pipeline-parallel trainer over heterogeneous stages.
 
@@ -143,6 +168,8 @@ class GPipeTrainer:
         mesh: Mesh | None = None,
         num_microbatches: int = 4,
         axis_name: str = "stages",
+        data_parallel: int = 1,
+        data_axis: str = "data",
     ):
         import optax
         from jax.flatten_util import ravel_pytree
@@ -158,18 +185,26 @@ class GPipeTrainer:
             )
         self.M = int(num_microbatches)
         self.axis = axis_name
+        self.data_axis = data_axis
         if mesh is None:
-            devices = jax.devices()
-            if len(devices) < self.S:
-                raise ValueError(
-                    f"{self.S} stages need {self.S} devices, have {len(devices)}"
-                )
-            mesh = Mesh(np.array(devices[: self.S]), (axis_name,))
+            mesh = pipeline_mesh(
+                self.S, int(data_parallel), axis_name=axis_name,
+                data_axis=data_axis,
+            )
+        elif int(data_parallel) > 1 and mesh.shape.get(data_axis, 1) != int(
+            data_parallel
+        ):
+            raise ValueError(
+                f"data_parallel={data_parallel} conflicts with the "
+                f"explicit mesh (its {data_axis!r} axis has size "
+                f"{mesh.shape.get(data_axis, 1)}) — pass one or the other"
+            )
         if mesh.shape[axis_name] != self.S:
             raise ValueError(
                 f"mesh axis {axis_name!r} has size {mesh.shape[axis_name]}, "
                 f"need {self.S} (one device per stage)"
             )
+        self.dp = mesh.shape.get(data_axis, 1)
         self.mesh = mesh
         self.optimizer = optimizer or optax.adam(1e-2)
 
@@ -186,6 +221,9 @@ class GPipeTrainer:
         )
         self._stage_sh = NamedSharding(mesh, P(axis_name))
         self._rep_sh = NamedSharding(mesh, P())
+        # microbatch spec: [M, mb, ...] rows split over the data axis
+        self._mb_spec = P(None, data_axis) if self.dp > 1 else P()
+        self._mb_sh = NamedSharding(mesh, self._mb_spec)
         self.params = jax.device_put(stacked, self._stage_sh)
         # optimizer slots mirror the stacked layout; scalar counters
         # replicate
@@ -306,13 +344,21 @@ class GPipeTrainer:
                 one_tick, (recv0, outputs0, jnp.float32(0.0)), jnp.arange(ticks)
             )
             loss = jax.lax.psum(loss_sum, axis) / M
+            if self.dp > 1:
+                # each data replica's loss is the mean over its local
+                # rows; the global mean averages the replicas (equal
+                # row counts — the microbatch spec splits evenly)
+                loss = jax.lax.pmean(loss, self.data_axis)
             return loss, outputs[None]
 
+        out_mb_spec = (
+            P(self.axis, None, self.data_axis) if self.dp > 1 else P(self.axis)
+        )
         return jax.shard_map(
             per_device,
             mesh=self.mesh,
-            in_specs=(P(self.axis), P(), P()),
-            out_specs=(P(), P(self.axis)),
+            in_specs=(P(self.axis), self._mb_spec, self._mb_spec),
+            out_specs=(P(), out_mb_spec),
             check_vma=False,
         )
 
@@ -335,7 +381,7 @@ class GPipeTrainer:
         state_sh = jax.tree.map(lambda l: l.sharding, self.opt_state)
         return jax.jit(
             step,
-            in_shardings=(self._stage_sh, state_sh, self._rep_sh, self._rep_sh),
+            in_shardings=(self._stage_sh, state_sh, self._mb_sh, self._mb_sh),
             out_shardings=(self._stage_sh, state_sh, self._rep_sh),
             donate_argnums=(0, 1),
         )
@@ -367,12 +413,14 @@ class GPipeTrainer:
         y = np.asarray(y)
         n = len(x)
         M = self.M
-        batch_size = max(M, (batch_size // M) * M)
+        grain = M * self.dp  # microbatch rows must split over data replicas
+        batch_size = max(grain, (batch_size // grain) * grain)
         if self._shapes is None:
-            mb_x = jnp.zeros((batch_size // M,) + x.shape[1:], x.dtype)
+            # boundary shapes are per-DEVICE: the local microbatch slice
+            mb_x = jnp.zeros((batch_size // grain,) + x.shape[1:], x.dtype)
             self._infer_shapes(mb_x)
         # the compiled pipeline is specialized to one microbatch shape
-        batch_size = self.M * self.mb_rows
+        batch_size = self.M * self.mb_rows * self.dp
         nb = max(1, int(np.ceil(n / batch_size)))
         idx = np.arange(nb * batch_size) % n
         if self._train_step is None:
@@ -406,28 +454,42 @@ class GPipeTrainer:
         x = np.asarray(x)
         n = len(x)
         M = self.M
-        batch_size = max(M, (batch_size // M) * M)
+        grain = M * self.dp
+        batch_size = max(grain, (batch_size // grain) * grain)
         if self._shapes is None:
-            mb_x = jnp.zeros((batch_size // M,) + x.shape[1:], x.dtype)
+            mb_x = jnp.zeros((batch_size // grain,) + x.shape[1:], x.dtype)
             self._infer_shapes(mb_x)
-        batch_size = self.M * self.mb_rows  # fixed microbatch shape
+        batch_size = self.M * self.mb_rows * self.dp  # fixed microbatch shape
         if self._predict_fn is None:
             forward = self._forward(collect_outputs=True, with_loss=False)
+            out_mb_spec = (
+                P(self.axis, None, self.data_axis)
+                if self.dp > 1
+                else P(self.axis)
+            )
             self._predict_fn = jax.jit(
                 lambda p, xm, ym: forward(p, xm, ym)[1],
-                in_shardings=(self._stage_sh, self._rep_sh, self._rep_sh),
-                out_shardings=NamedSharding(self.mesh, P(self.axis)),
+                in_shardings=(self._stage_sh, self._mb_sh, self._mb_sh),
+                out_shardings=NamedSharding(self.mesh, out_mb_spec),
             )
-        out_shape = self._shapes[-1].shape
+        out_shape = self._shapes[-1].shape  # local microbatch output
         nb = max(1, int(np.ceil(n / batch_size)))
         idx = np.arange(nb * batch_size) % n
-        ym0 = np.zeros((M, 1), np.float32)  # targets unused without loss
+        # targets unused without loss; dp rows so the data spec splits
+        ym0 = np.zeros((M, self.dp), np.float32)
         outs = []
         for b in range(nb):
             rows = idx[b * batch_size : (b + 1) * batch_size]
             xm = self._microbatches(x[rows], batch_size)
             res = np.asarray(self._predict_fn(self.params, xm, ym0))
-            outs.append(res[self.S - 1].reshape((batch_size,) + out_shape[1:]))
+            # last stage's shard: [M, dp·elems_local]; replica r's rows
+            # are the r-th contiguous chunk of each microbatch, so
+            # [M, dp, mb_local, ...] flattens back to the input order
+            outs.append(
+                res[self.S - 1].reshape(
+                    (M, self.dp, self.mb_rows) + out_shape[1:]
+                ).reshape((batch_size,) + out_shape[1:])
+            )
         return np.concatenate(outs)[:n]
 
     def stage_weights(self, s: int):

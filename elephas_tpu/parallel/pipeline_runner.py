@@ -176,7 +176,7 @@ class PipelineRunner:
     compiled Keras model (``SparkModel(pipeline_parallel=S)``)."""
 
     def __init__(self, model, num_stages: int, num_microbatches: int = 4,
-                 mesh=None):
+                 mesh=None, data_parallel: int = 1):
         import jax
         import jax.numpy as jnp
 
@@ -187,7 +187,7 @@ class PipelineRunner:
             raise ValueError("model must be compiled before pipeline training")
         self.model = model
         self.num_stages = num_stages
-        self.num_workers = num_stages  # mesh devices = stages
+        self.num_workers = max(1, int(data_parallel))  # data replicas
         layers = _chain_layers(model)
         for l in layers:
             if l.non_trainable_variables:
@@ -234,6 +234,7 @@ class PipelineRunner:
             optimizer=_optax_from_keras(model.optimizer),
             mesh=mesh,
             num_microbatches=num_microbatches,
+            data_parallel=data_parallel,
         )
         self._eval_runner = None
 

@@ -112,25 +112,22 @@ class SparkModel:
                     f"pipeline_parallel={pipeline_parallel} exceeds the "
                     f"{len(jax.devices())} available devices"
                 )
-            if num_workers is not None and num_workers != self.pipeline_parallel:
-                raise ValueError(
-                    f"num_workers={num_workers} conflicts with "
-                    f"pipeline_parallel={pipeline_parallel}: the pipeline "
-                    f"occupies one device per stage (composing DP around "
-                    f"it is a future extension) — drop num_workers"
-                )
             if self.mode != "synchronous":
                 raise ValueError(
                     "pipeline_parallel trains synchronously (one model, "
                     "depth-sharded); asynchronous/hogwild modes apply to "
                     "data-parallel replicas"
                 )
-            from jax.sharding import Mesh
+            from elephas_tpu.ops.pipeline import pipeline_mesh
 
-            self.mesh = Mesh(
-                np.array(jax.devices()[: self.pipeline_parallel]), ("stages",)
-            )
-            self.num_workers = self.pipeline_parallel
+            # DP×PP: num_workers asks for data replicas AROUND the
+            # pipeline — a ('data','stages') mesh where each data row
+            # runs its own activation ring (capped to the device budget,
+            # like the TP/SP branches)
+            max_dp = max(1, len(jax.devices()) // self.pipeline_parallel)
+            dp = min(num_workers, max_dp) if num_workers else 1
+            self.mesh = pipeline_mesh(self.pipeline_parallel, dp)
+            self.num_workers = dp
             self._runner = None
             self._parameter_server = None
             self.training_histories = []
@@ -672,6 +669,7 @@ class SparkModel:
                     self.pipeline_parallel,
                     num_microbatches=self.pipeline_microbatches,
                     mesh=self.mesh,
+                    data_parallel=self.num_workers,
                 )
             elif self.model_parallel > 1:
                 from elephas_tpu.parallel.tensor import TensorParallelRunner

@@ -334,7 +334,7 @@ def test_spark_model_pipeline_parallel_trains(blobs):
 
     x, y, d, k = blobs
     sm = SparkModel(_pp_mlp(d, k, seed=71), pipeline_parallel=2)
-    assert sm.num_workers == 2
+    assert sm.num_workers == 1  # data replicas (dp×pp needs num_workers>1)
     runner = sm._get_runner()
     stages = runner.stage_summary()
     assert len(stages) == 2 and all(stages), stages
@@ -560,8 +560,11 @@ def test_pipeline_parallel_optimizer_option_guards(blobs):
     with pytest.raises(ValueError, match="weight_decay"):
         SparkModel(m, pipeline_parallel=2).fit((x[:64], y[:64]), epochs=1)
 
-    with pytest.raises(ValueError, match="num_workers"):
-        SparkModel(_pp_mlp(d, k), pipeline_parallel=2, num_workers=8)
+    # num_workers now composes DP around the pipeline (capped to the
+    # device budget: 8 devices / 2 stages = 4 replicas)
+    sm_dp = SparkModel(_pp_mlp(d, k), pipeline_parallel=2, num_workers=8)
+    assert sm_dp.num_workers == 4
+    assert dict(sm_dp.mesh.shape) == {"data": 4, "stages": 2}
 
     # amsgrad raises: keras maxes raw second moments, optax maxes
     # bias-corrected ones — no exact mirror exists
@@ -600,3 +603,84 @@ def test_pipeline_parallel_save_load_roundtrip(tmp_path, blobs):
     )
     h = restored.fit((x[:256], y[:256]), epochs=1, batch_size=64)
     assert np.isfinite(h["loss"]).all()
+
+
+# -- DP×PP composition ---------------------------------------------------
+
+
+def test_gpipe_data_parallel_matches_pipeline_only():
+    """data_parallel replicates the pipeline over a ('data','stages')
+    mesh. Synchronous DP with the same global batch is numerically the
+    SAME algorithm, so losses, weights, and predictions must match the
+    1-ring trainer to float tolerance."""
+    import optax
+
+    from elephas_tpu.ops.pipeline import GPipeTrainer
+
+    def stage0(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def stage1(p, h):
+        return h @ p["w"]
+
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+
+    def mk():
+        return [
+            {"w": jax.random.normal(k1, (8, 6)) * 0.3},
+            {"w": jax.random.normal(k2, (6, 4)) * 0.3},
+        ]
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(96, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=96).astype(np.int32)
+
+    t1 = GPipeTrainer(
+        [stage0, stage1], mk(), _xent, optimizer=optax.sgd(0.05),
+        num_microbatches=2,
+    )
+    h1 = t1.fit(x, y, epochs=3, batch_size=16)
+
+    t2 = GPipeTrainer(
+        [stage0, stage1], mk(), _xent, optimizer=optax.sgd(0.05),
+        num_microbatches=2, data_parallel=4,
+    )
+    assert dict(t2.mesh.shape) == {"data": 4, "stages": 2}
+    h2 = t2.fit(x, y, epochs=3, batch_size=16)
+
+    np.testing.assert_allclose(h1["loss"], h2["loss"], atol=1e-5)
+    for s in range(2):
+        np.testing.assert_allclose(
+            np.asarray(t1.stage_weights(s)["w"]),
+            np.asarray(t2.stage_weights(s)["w"]),
+            atol=1e-5,
+        )
+    # predict reassembly: replica row chunks must come back in input
+    # order, including the wrap-pad tail (50 % 32 != 0)
+    np.testing.assert_allclose(
+        t1.predict(x[:50]), t2.predict(x[:50]), atol=1e-5
+    )
+
+
+def test_spark_model_dp_pipeline_trains(blobs):
+    """SparkModel(pipeline_parallel=2, num_workers=2): 2 data replicas
+    × 2 stages on a ('data','stages') mesh, matching the pipeline-only
+    run exactly and solving the task through the L5 surface."""
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    sm1 = SparkModel(_pp_mlp(d, k, seed=91), pipeline_parallel=2)
+    h1 = sm1.fit((x[:512], y[:512]), epochs=3, batch_size=64)
+
+    sm2 = SparkModel(_pp_mlp(d, k, seed=91), pipeline_parallel=2,
+                     num_workers=2)
+    assert dict(sm2.mesh.shape) == {"data": 2, "stages": 2}
+    assert sm2.num_workers == 2
+    h2 = sm2.fit((x[:512], y[:512]), epochs=3, batch_size=64)
+
+    np.testing.assert_allclose(h1["loss"], h2["loss"], atol=1e-5)
+    acc = float((sm2.predict(x[:200]).argmax(1) == y[:200]).mean())
+    assert acc > 0.9, acc
+    # config round-trips the data-replica count
+    assert sm2.get_config()["num_workers"] == 2
