@@ -439,15 +439,90 @@ class GPipeTrainer:
                     self.params, self.opt_state, xm, ym
                 )
                 losses.append(loss)
-            epoch_loss = float(np.mean([np.asarray(l) for l in losses]))
-            history["loss"].append(epoch_loss)
-            if verbose:
-                logger.info(
-                    "epoch %d/%d - loss %.4f", epoch + 1, epochs, epoch_loss
+            self._finish_epoch(
+                history, losses, epoch, epochs, verbose, callbacks
+            )
+        return history
+
+    def _finish_epoch(self, history, losses, epoch, epochs, verbose,
+                      callbacks):
+        """Shared staged/streamed epoch bookkeeping: history append,
+        logging, callback dispatch."""
+        epoch_loss = float(np.mean([np.asarray(l) for l in losses]))
+        history["loss"].append(epoch_loss)
+        if verbose:
+            logger.info(
+                "epoch %d/%d - loss %.4f", epoch + 1, epochs, epoch_loss
+            )
+        if callbacks:
+            for cb in callbacks:
+                cb(epoch, epoch_loss)
+        return epoch_loss
+
+    def fit_stream(self, stream, epochs: int = 1, verbose: int = 0,
+                   callbacks=None):
+        """Streamed training over :class:`ShardedStream` blocks shaped
+        ``[dp, steps, B, ...]`` — each step's global batch is the
+        ``dp`` row-shards concatenated (``dp·B`` rows), microbatched
+        through the ring like :meth:`fit`. Blocks never all live in
+        device memory at once; the next block's host gather runs under
+        the current block's compute (async dispatch).
+
+        The stream's (per-worker) batch must divide into the ``M``
+        microbatches — every step then carries the exact compiled shape
+        with no mid-epoch padding (the stream wrap-pads short shard
+        tails internally, matching the staged path's tail semantics).
+        """
+        from elephas_tpu.data.streaming import prefetch_blocks
+
+        if stream.num_workers != self.dp:
+            raise ValueError(
+                f"stream has {stream.num_workers} shards for a "
+                f"{self.dp}-replica data axis"
+            )
+        M, dp = self.M, self.dp
+        if stream.batch_size % M:
+            raise ValueError(
+                f"stream batch_size={stream.batch_size} must be a "
+                f"multiple of num_microbatches={M} (else every step "
+                f"would pad duplicated rows, biasing gradients)"
+            )
+        if self._shapes is None:
+            x1 = np.asarray(stream.x[0:1])
+            self._infer_shapes(
+                jnp.zeros(
+                    (stream.batch_size // M,) + x1.shape[1:], x1.dtype
                 )
-            if callbacks:
-                for cb in callbacks:
-                    cb(epoch, epoch_loss)
+            )
+        need = M * self.mb_rows * dp
+        if dp * stream.batch_size != need:
+            raise ValueError(
+                f"stream supplies {dp * stream.batch_size} rows/step but "
+                f"the compiled pipeline takes {need} — match the stream "
+                f"batch_size to the fit batch_size"
+            )
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+
+        history: dict[str, list[float]] = {"loss": []}
+        for epoch in range(epochs):
+            losses = []
+            for xb, yb, steps in prefetch_blocks(stream.blocks()):
+                for t in range(steps):
+                    xt, yt = xb[:, t], yb[:, t]  # [dp, B, ...]
+                    x_flat = xt.reshape((need,) + xt.shape[2:])
+                    y_flat = np.asarray(yt).reshape(
+                        (need,) + yt.shape[2:]
+                    )
+                    xm = self._microbatches(x_flat, need)
+                    ym = y_flat.reshape((M, need // M) + y_flat.shape[1:])
+                    self.params, self.opt_state, loss = self._train_step(
+                        self.params, self.opt_state, xm, ym
+                    )
+                    losses.append(loss)
+            self._finish_epoch(
+                history, losses, epoch, epochs, verbose, callbacks
+            )
         return history
 
     def predict(self, x, batch_size: int = 32) -> np.ndarray:

@@ -269,6 +269,19 @@ class PipelineRunner:
     def _fit_partitions_to_mesh(self, partitions):
         return partitions
 
+    def _wrap_callbacks(self, callbacks):
+        """Callbacks observe the master model (PS publication,
+        checkpoints) — sync stage weights back before each one fires."""
+        if not callbacks:
+            return None
+
+        def wrapped_cb(epoch, loss):
+            self._write_back()
+            for cb in callbacks:
+                cb(epoch, loss)
+
+        return [wrapped_cb]
+
     def run_epochs(self, partitions, epochs, batch_size, verbose=0, callbacks=None):
         if len(partitions) == 1:
             # the pipeline consumes whole batches; avoid a second full
@@ -277,28 +290,20 @@ class PipelineRunner:
         else:
             x = np.concatenate([np.asarray(p[0]) for p in partitions])
             y = np.concatenate([np.asarray(p[1]) for p in partitions])
-        wrapped = None
-        if callbacks:
-            # callbacks observe the master model (PS publication,
-            # checkpoints) — sync stage weights back first
-            def wrapped_cb(epoch, loss):
-                self._write_back()
-                for cb in callbacks:
-                    cb(epoch, loss)
-
-            wrapped = [wrapped_cb]
         history = self.trainer.fit(
             x, y, epochs=epochs, batch_size=batch_size, verbose=verbose,
-            callbacks=wrapped,
+            callbacks=self._wrap_callbacks(callbacks),
         )
         self._write_back()
         return history
 
     def run_epochs_stream(self, stream, epochs, verbose=0, callbacks=None):
-        raise ValueError(
-            "out-of-core streaming is not supported with pipeline_parallel "
-            "yet; stage the dataset or use model_parallel/data-parallel"
+        history = self.trainer.fit_stream(
+            stream, epochs, verbose=verbose,
+            callbacks=self._wrap_callbacks(callbacks),
         )
+        self._write_back()
+        return history
 
     def evaluate(self, partitions, batch_size=32):
         self._write_back()

@@ -398,17 +398,6 @@ class SparkModel:
             or lazily_backed
             or estimate_nbytes(x, y) > self.STREAM_THRESHOLD_BYTES
         )
-        if self.pipeline_parallel > 1 and should_stream:
-            # the pipeline runner has no streaming path yet; in-memory
-            # arrays can always stage (GPipeTrainer feeds per-batch), so
-            # only explicit streaming requests / lazy sources must fail
-            if lazily_backed or stream_block_steps is not None or steps_per_epoch:
-                raise ValueError(
-                    "out-of-core streaming is not supported with "
-                    "pipeline_parallel yet; stage the dataset or use "
-                    "model_parallel/data-parallel"
-                )
-            should_stream = False
         if not should_stream:
             if self.pipeline_parallel > 1:
                 # the pipeline consumes whole batches — splitting into
@@ -437,10 +426,24 @@ class SparkModel:
             n_val = min(max(1, int(n * validation_split)), n - 1)
             val_partitions = [(np.asarray(x[n - n_val :]), np.asarray(y[n - n_val :]))]
             num_rows = n - n_val
+        # The DP runner interprets batch_size per worker (reference
+        # semantics), and the stream's batch is per worker — they agree.
+        # The TP/SP/PP trainers interpret batch_size as the GLOBAL
+        # batch, so their streams must divide it across the data
+        # replicas (with the staged path's own rounding) or the same
+        # fit() call would train a dp×-larger batch when it streams.
+        stream_batch = batch_size
+        if self.pipeline_parallel > 1:
+            m = self.pipeline_microbatches
+            stream_batch = max(
+                m, (batch_size // (m * self.num_workers)) * m
+            )
+        elif self.model_parallel > 1 or self.sequence_parallel > 1:
+            stream_batch = max(1, batch_size // self.num_workers)
         stream = ShardedStream(
             x,
             y,
-            batch_size,
+            stream_batch,
             self.num_workers,
             block_steps=stream_block_steps or 16,
             steps_per_epoch=steps_per_epoch,
