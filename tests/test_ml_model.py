@@ -243,3 +243,48 @@ def test_estimator_pipeline_parallel_param(blobs):
     transformer = est.fit(df)
     out = transformer.transform(df)
     assert "prediction" in out.columns
+
+
+def test_estimator_sequence_parallel_param(blobs):
+    """r3: sequence_parallel rides the string-keyed param layer into
+    SparkModel (the ring itself is exercised in
+    test_sequence_parallel.py; a non-attention model trains correctly
+    with replicated weights either way)."""
+    import json
+
+    import keras
+
+    from elephas_tpu.data.dataframe import SparkSession
+    from elephas_tpu.ml_model import ElephasEstimator
+
+    x, y, d, k = blobs
+    keras.utils.set_random_seed(57)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((d,)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(k, activation="softmax"),
+        ]
+    )
+    session = SparkSession()
+    df = session.createDataFrame(
+        [(row, float(label)) for row, label in zip(x[:320], y[:320])],
+        schema=["features", "label"],
+    )
+    est = ElephasEstimator(
+        keras_model_config=model.to_json(),
+        optimizer_config=json.dumps(
+            keras.optimizers.serialize(keras.optimizers.Adam(1e-2))
+        ),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+        epochs=3,
+        batch_size=32,
+        sequence_parallel=2,
+        categorical_labels=False,
+        nb_classes=k,
+    )
+    assert est.getSequenceParallel() == 2
+    transformer = est.fit(df)
+    out = transformer.transform(df)
+    assert "prediction" in out.columns
