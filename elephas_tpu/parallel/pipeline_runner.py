@@ -20,15 +20,15 @@ Scope (honest restrictions, enforced loudly):
 - the keras optimizer maps to its optax equivalent (adam/sgd/rmsprop/
   adamw) — per-stage moment slots shard with the stage.
 
-Inference/evaluate run data-parallel through a
-:class:`~elephas_tpu.worker.MeshRunner` after the trained stage weights
-write back into the master model: PP pays off in training (activations
-+ optimizer state); forward-only fits one chip whenever the weights do.
+Inference/evaluate run through the ring too: ``predict`` pipelines
+microbatches over the stage mesh (weights stay depth-sharded), and
+``evaluate`` aggregates the compiled per-sample loss + metric states
+over the gathered predictions — no device ever holds the full model.
 
 The training history is loss-only (threading metric state through the
 ring would put metric updates on the last stage's critical path); use
 ``fit(validation_split=...)`` for per-epoch ``val_*`` metrics — they
-run through the data-parallel evaluator.
+run through the ring evaluator.
 """
 
 from __future__ import annotations
@@ -189,6 +189,12 @@ class PipelineRunner:
         self.num_stages = num_stages
         self.num_workers = max(1, int(data_parallel))  # data replicas
         layers = _chain_layers(model)
+        _REG_ATTRS = (
+            "kernel_regularizer", "bias_regularizer",
+            "activity_regularizer", "beta_regularizer",
+            "gamma_regularizer", "embeddings_regularizer",
+            "recurrent_regularizer",
+        )
         for l in layers:
             if l.non_trainable_variables:
                 raise ValueError(
@@ -197,6 +203,15 @@ class PipelineRunner:
                     f"seeds); pipeline stages are pure functions of their "
                     f"trainable parameters — use model_parallel for such "
                     f"models"
+                )
+            regs = [a for a in _REG_ATTRS if getattr(l, a, None) is not None]
+            if regs:
+                raise ValueError(
+                    f"pipeline_parallel: layer {l.name!r} has {regs}; "
+                    f"add_loss/regularizer penalties do not thread "
+                    f"through the stage ring (training would silently "
+                    f"drop them from the objective and evaluate from the "
+                    f"reported loss) — remove them or use model_parallel"
                 )
         self._stage_layers = _partition_balanced(layers, num_stages)
 
@@ -236,7 +251,6 @@ class PipelineRunner:
             num_microbatches=num_microbatches,
             data_parallel=data_parallel,
         )
-        self._eval_runner = None
 
     # -- weight sync ---------------------------------------------------
 
@@ -251,18 +265,6 @@ class PipelineRunner:
     def host_weights(self):
         self._write_back()
         return self.model.get_weights()
-
-    def _dp_runner(self):
-        """Data-parallel runner over all devices for evaluate/predict
-        (forward-only fits one chip whenever the weights do)."""
-        if self._eval_runner is None:
-            from elephas_tpu.parallel.mesh import worker_mesh
-            from elephas_tpu.worker import MeshRunner
-
-            self._eval_runner = MeshRunner(
-                self.model, "synchronous", "epoch", worker_mesh(None)
-            )
-        return self._eval_runner
 
     # -- MeshRunner-shaped interface ------------------------------------
 
@@ -306,12 +308,51 @@ class PipelineRunner:
         return history
 
     def evaluate(self, partitions, batch_size=32):
-        self._write_back()
-        return self._dp_runner().evaluate(partitions, batch_size)
+        """Ring-based evaluate: predictions come from the pipeline
+        forward itself (stage weights stay depth-sharded — the DP
+        evaluate would replicate the full model per device), then the
+        per-sample compiled loss and metric states aggregate over the
+        gathered predictions (small: ``[N, out_dim]``).
+
+        Stage functions are pure, so ``add_loss``/activity-regularizer
+        extras do not exist on this path (they are equally absent from
+        pipeline training)."""
+        import jax.numpy as jnp
+
+        from elephas_tpu.worker import KerasIntrospection
+
+        x = self._concat_rows([p[0] for p in partitions])
+        y = self._concat_rows([p[1] for p in partitions])
+        y_pred = jnp.asarray(self.trainer.predict(x, batch_size=batch_size))
+
+        intro = KerasIntrospection()
+        intro.model = self.model
+        values = intro._per_sample_loss_fn()(jnp.asarray(y), y_pred)
+        results = {k: float(jnp.mean(values[k])) for k in intro._loss_keys()}
+        metric_objects = intro._unwrapped_metrics(x[:1], y[:1])
+        mvs = [
+            m.stateless_update_state(mv, jnp.asarray(y), y_pred)
+            for (m, _i, _n), mv in zip(
+                metric_objects, intro._zero_metric_state(metric_objects)
+            )
+        ]
+        tail: dict[str, list[float]] = {}
+        intro._history_from_metrics(tail, metric_objects, mvs)
+        results.update({k: v[0] for k, v in tail.items()})
+        return results
+
+    @staticmethod
+    def _concat_rows(parts):
+        """Rows of the partitions, skipping the copy when there is only
+        one (per-epoch validation always passes a single partition)."""
+        parts = [p for p in parts if len(p)]
+        if len(parts) == 1:
+            return np.asarray(parts[0])
+        return np.concatenate([np.asarray(p) for p in parts])
 
     def predict(self, feature_partitions, batch_size=32):
-        self._write_back()
-        return self._dp_runner().predict(feature_partitions, batch_size)
+        x = self._concat_rows(list(feature_partitions))
+        return self.trainer.predict(x, batch_size=batch_size)
 
     def save_checkpoint(self, directory, epoch, history=None):
         """Stage-sharded orbax snapshot of the flat ``[S, P_max]`` params

@@ -799,3 +799,25 @@ def test_gpipe_fit_stream_guards():
     t.fit_stream(ShardedStream(x, y, 8, num_workers=2), epochs=1)
     with pytest.raises(ValueError, match="rows/step"):
         t.fit_stream(ShardedStream(x, y, 16, num_workers=2), epochs=1)
+
+
+def test_pp_ring_evaluate_matches_keras(blobs):
+    """evaluate() through the ring (stage weights depth-sharded, loss +
+    metrics over gathered predictions) must match stock keras evaluate
+    on the same trained weights."""
+    import keras
+
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    sm = SparkModel(_pp_mlp(d, k, seed=23), pipeline_parallel=2,
+                    num_workers=2)
+    sm.fit((x[:512], y[:512]), epochs=3, batch_size=64)
+    loss, acc = sm.evaluate(x[:512], y[:512], batch_size=64)
+
+    # master model carries the written-back weights; keras is the oracle
+    ref_loss, ref_acc = sm.master_network.evaluate(
+        x[:512], y[:512], verbose=0
+    )
+    np.testing.assert_allclose(loss, ref_loss, rtol=1e-4)
+    np.testing.assert_allclose(acc, ref_acc, rtol=1e-4)
