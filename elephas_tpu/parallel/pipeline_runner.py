@@ -213,6 +213,26 @@ class PipelineRunner:
                     f"drop them from the objective and evaluate from the "
                     f"reported loss) — remove them or use model_parallel"
                 )
+        # attribute scan can't see custom layers calling add_loss() in
+        # call(); probe one forward pass and check the collected losses
+        try:
+            spec = model.inputs[0]
+            probe = np.zeros(
+                (1,) + tuple(int(d) if d else 1 for d in spec.shape[1:]),
+                dtype=getattr(spec.dtype, "name", spec.dtype),
+            )
+            model(probe, training=True)
+            extras = list(model.losses)
+        except Exception:  # exotic inputs: fall back to the attr scan
+            extras = []
+        if extras:
+            raise ValueError(
+                "pipeline_parallel: the model produces add_loss "
+                "penalties; they do not thread through the stage ring "
+                "(training would silently drop them from the objective "
+                "and evaluate from the reported loss) — remove them or "
+                "use model_parallel"
+            )
         self._stage_layers = _partition_balanced(layers, num_stages)
 
         def make_stage_fn(group):
@@ -251,6 +271,7 @@ class PipelineRunner:
             num_microbatches=num_microbatches,
             data_parallel=data_parallel,
         )
+        self._eval_helpers = None  # (intro, per-sample loss, metrics)
 
     # -- weight sync ---------------------------------------------------
 
@@ -319,17 +340,26 @@ class PipelineRunner:
         pipeline training)."""
         import jax.numpy as jnp
 
-        from elephas_tpu.worker import KerasIntrospection
-
         x = self._concat_rows([p[0] for p in partitions])
         y = self._concat_rows([p[1] for p in partitions])
         y_pred = jnp.asarray(self.trainer.predict(x, batch_size=batch_size))
 
-        intro = KerasIntrospection()
-        intro.model = self.model
-        values = intro._per_sample_loss_fn()(jnp.asarray(y), y_pred)
+        if self._eval_helpers is None:
+            # per-epoch validation calls this every epoch; the loss fn
+            # and metric objects (whose creation runs a master-model
+            # forward) are identical across calls — build once
+            from elephas_tpu.worker import KerasIntrospection
+
+            intro = KerasIntrospection()
+            intro.model = self.model
+            self._eval_helpers = (
+                intro,
+                intro._per_sample_loss_fn(),
+                intro._unwrapped_metrics(x[:1], y[:1]),
+            )
+        intro, per_sample, metric_objects = self._eval_helpers
+        values = per_sample(jnp.asarray(y), y_pred)
         results = {k: float(jnp.mean(values[k])) for k in intro._loss_keys()}
-        metric_objects = intro._unwrapped_metrics(x[:1], y[:1])
         mvs = [
             m.stateless_update_state(mv, jnp.asarray(y), y_pred)
             for (m, _i, _n), mv in zip(

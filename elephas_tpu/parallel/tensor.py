@@ -228,9 +228,40 @@ class ShardedTrainer(KerasIntrospection):
         self._predict_fn = None
         self._sync_fn = None
         self._canon_fn = None
+        self._replicate_fn = None
         self._state = None  # (tv, ntv, ov) device arrays, live across fits
 
     # -- sharding helpers ----------------------------------------------
+
+    def _put_global(self, arr, sharding: NamedSharding):
+        """Host→device under an arbitrary sharding, multi-process safe.
+
+        Every gang process holds the identical full host value (the
+        SPMD contract, as in ``MeshRunner``); each materializes only its
+        addressable shards of the global array."""
+        arr = np.asarray(arr)
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    def _host(self, leaf):
+        """Device→host full value. Cross-process shards are all-gathered
+        in XLA (reshard to replicated) first — ``device_get`` alone
+        cannot read devices this process does not address."""
+        if not isinstance(leaf, jax.Array) or getattr(
+            leaf, "is_fully_addressable", True
+        ):
+            return np.asarray(leaf)
+        if self._replicate_fn is None:
+            # ONE cached jit wrapper: its compilation cache then hits per
+            # input shape/sharding (a fresh lambda per call would retrace
+            # and recompile the gather for every variable, every time)
+            self._replicate_fn = jax.jit(
+                lambda a: a, out_shardings=NamedSharding(self.mesh, P())
+            )
+        return np.asarray(self._replicate_fn(leaf))
 
     def _stacked(self, sharding: NamedSharding) -> NamedSharding:
         """Per-replica layout: leading ``[DP]`` axis over 'data', the
@@ -256,7 +287,7 @@ class ShardedTrainer(KerasIntrospection):
             leaf = np.asarray(v.value)
             if self.per_replica:
                 leaf = np.broadcast_to(leaf[None], (self.dp,) + leaf.shape)
-            return jax.device_put(leaf, s)
+            return self._put_global(leaf, s)
 
         tv = [put(v, s) for v, s in zip(self.model.trainable_variables, tv_sh)]
         ntv = [
@@ -293,11 +324,11 @@ class ShardedTrainer(KerasIntrospection):
     def _write_back(self, state=None):
         tv, ntv, ov = self._canonical(state)
         for var, leaf in zip(self.model.trainable_variables, tv):
-            var.assign(np.asarray(jax.device_get(leaf)))
+            var.assign(self._host(leaf))
         for var, leaf in zip(self.model.non_trainable_variables, ntv):
-            var.assign(np.asarray(jax.device_get(leaf)))
+            var.assign(self._host(leaf))
         for var, leaf in zip(self.model.optimizer.variables, ov):
-            var.assign(np.asarray(jax.device_get(leaf)))
+            var.assign(self._host(leaf))
 
     def _eval_state(self):
         """(tv, ntv) in single-copy layout for evaluate/predict — the live
@@ -306,11 +337,11 @@ class ShardedTrainer(KerasIntrospection):
             tv, ntv, _ = self._canonical()
             return tv, ntv
         tv = [
-            jax.device_put(np.asarray(v.value), s)
+            self._put_global(np.asarray(v.value), s)
             for v, s in zip(self.model.trainable_variables, self._tv_sh)
         ]
         ntv = [
-            jax.device_put(np.asarray(v.value), s)
+            self._put_global(np.asarray(v.value), s)
             for v, s in zip(self.model.non_trainable_variables, self._ntv_sh)
         ]
         return tv, ntv
@@ -392,8 +423,14 @@ class ShardedTrainer(KerasIntrospection):
     def _zero_mvs(self, metric_objects):
         zeros = self._zero_metric_state(metric_objects)
         if self.per_replica:
+            mv_sh = NamedSharding(self.mesh, P("data"))
             zeros = [
-                [np.broadcast_to(z[None], (self.dp,) + z.shape) for z in ms]
+                [
+                    self._put_global(
+                        np.broadcast_to(z[None], (self.dp,) + z.shape), mv_sh
+                    )
+                    for z in ms
+                ]
                 for ms in zeros
             ]
         return zeros
@@ -402,7 +439,7 @@ class ShardedTrainer(KerasIntrospection):
         """Final cross-replica metric state (additive Mean-type states)."""
         if not self.per_replica:
             return mvs
-        return [[np.asarray(z).sum(axis=0) for z in ms] for ms in mvs]
+        return [[self._host(z).sum(axis=0) for z in ms] for ms in mvs]
 
     # -- fit -----------------------------------------------------------
 
@@ -450,9 +487,9 @@ class ShardedTrainer(KerasIntrospection):
                 sw = sw.reshape(dp, -1)
             tv, ntv, ov, mvs, loss = self._step_fn(
                 tv, ntv, ov, mvs,
-                jax.device_put(xb, self._data_sh),
-                jax.device_put(yb, self._data_sh),
-                jax.device_put(sw, self._data_sh),
+                self._put_global(xb, self._data_sh),
+                self._put_global(yb, self._data_sh),
+                self._put_global(sw, self._data_sh),
             )
             if self.per_replica and self.frequency == "batch":
                 tv, ntv = self._sync_fn(tv, ntv)
@@ -512,7 +549,7 @@ class ShardedTrainer(KerasIntrospection):
         num = 0.0
         den = 0.0
         for loss, w in losses:
-            val = np.asarray(loss)
+            val = self._host(loss)
             if val.ndim == 0:
                 num += float(val) * float(np.sum(w))
             else:
@@ -567,9 +604,9 @@ class ShardedTrainer(KerasIntrospection):
                         sw = sw.reshape(-1)
                     tv, ntv, ov, mvs, loss = self._step_fn(
                         tv, ntv, ov, mvs,
-                        jax.device_put(xt, self._data_sh),
-                        jax.device_put(yt, self._data_sh),
-                        jax.device_put(sw, self._data_sh),
+                        self._put_global(xt, self._data_sh),
+                        self._put_global(yt, self._data_sh),
+                        self._put_global(sw, self._data_sh),
                     )
                     if self.per_replica and self.frequency == "batch":
                         tv, ntv = self._sync_fn(tv, ntv)
@@ -685,7 +722,12 @@ class ShardedTrainer(KerasIntrospection):
         for b in range(nb):
             yb_b = jax.tree.map(lambda a: a[b], yb)
             mvs, sums, wsum = self._eval_step(
-                tv, ntv, mvs, sums, wsum, xb[b], yb_b, wb[b]
+                tv, ntv, mvs, sums, wsum,
+                self._put_global(xb[b], self._data_sh),
+                jax.tree.map(
+                    lambda a: self._put_global(a, self._data_sh), yb_b
+                ),
+                self._put_global(wb[b], self._data_sh),
             )
         denom = float(np.asarray(wsum))
         results = {k: float(np.asarray(sums[k])) / denom for k in loss_keys}
@@ -718,15 +760,10 @@ class ShardedTrainer(KerasIntrospection):
             rows = idx[b * batch_size : (b + 1) * batch_size]
             # fetch inside the loop: async dispatch would otherwise keep
             # every batch's input+output resident in HBM at once
-            outs.append(
-                np.asarray(
-                    jax.device_get(
-                        self._predict_fn(
-                            tv, ntv, jax.device_put(x[rows], self._data_sh)
-                        )
-                    )
-                )
+            out = self._predict_fn(
+                tv, ntv, self._put_global(x[rows], self._data_sh)
             )
+            outs.append(np.asarray(jax.tree.map(self._host, out)))
         return np.concatenate(outs)[:n]
 
     # -- sharded checkpointing -------------------------------------------
@@ -742,7 +779,7 @@ class ShardedTrainer(KerasIntrospection):
 
         tv, ntv, ov = self._canonical() if self._state is not None else (
             self._eval_state() + ([
-                jax.device_put(np.asarray(v.value), s)
+                self._put_global(np.asarray(v.value), s)
                 for v, s in zip(self.model.optimizer.variables, self._ov_sh)
             ],)
         )
@@ -787,11 +824,11 @@ class ShardedTrainer(KerasIntrospection):
             self._state = (tv, ntv, ov)
         # keep the master model in sync for save()/predict-parity paths
         for var, leaf in zip(self.model.trainable_variables, tv):
-            var.assign(np.asarray(jax.device_get(leaf)))
+            var.assign(self._host(leaf))
         for var, leaf in zip(self.model.non_trainable_variables, ntv):
-            var.assign(np.asarray(jax.device_get(leaf)))
+            var.assign(self._host(leaf))
         for var, leaf in zip(self.model.optimizer.variables, ov):
-            var.assign(np.asarray(jax.device_get(leaf)))
+            var.assign(self._host(leaf))
         return meta
 
     def sharding_summary(self) -> dict[str, str]:
