@@ -13,7 +13,11 @@ from elephas_tpu.models.mlp import mnist_mlp
 from elephas_tpu.models.convnet import cifar10_cnn
 from elephas_tpu.models.lstm import imdb_lstm
 from elephas_tpu.models.resnet import resnet50, resnet
-from elephas_tpu.models.transformer import transformer_classifier, transformer_lm
+from elephas_tpu.models.transformer import (
+    generate,
+    transformer_classifier,
+    transformer_lm,
+)
 from elephas_tpu.models.switch import switch_transformer_classifier
 
 __all__ = [
@@ -24,6 +28,7 @@ __all__ = [
     "resnet",
     "transformer_classifier",
     "transformer_lm",
+    "generate",
     "switch_transformer_classifier",
     "MoeFFN",
 ]

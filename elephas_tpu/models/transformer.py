@@ -249,3 +249,89 @@ def transformer_lm(
         metrics=["accuracy"],
     )
     return model
+
+
+def generate(
+    model,
+    prompt,
+    steps: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    seed: int = 0,
+):
+    """Autoregressive sampling from a :func:`transformer_lm` model.
+
+    ``prompt``: ``[B, P]`` int tokens (``P + steps`` must fit the
+    model's ``maxlen``). Returns ``[B, P + steps]`` tokens.
+    ``temperature=0`` is greedy argmax; otherwise softmax sampling at
+    that temperature, optionally truncated to the ``top_k`` most likely
+    tokens.
+
+    TPU-shaped: ONE jitted program — the sequence stays at the model's
+    fixed ``maxlen`` (causal attention makes positions ``>= t`` inert),
+    and ``lax.fori_loop`` advances a token at a time writing in place.
+    Recomputes the prefix each step (O(S²·L) like the training path —
+    the flash kernel keeps it MXU-tiled and O(S) memory); a KV-cache
+    decode path is a further optimization, not a semantics change.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    prompt = np.asarray(prompt)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    b, p = prompt.shape
+    maxlen = int(model.inputs[0].shape[1])
+    vocab = int(model.outputs[0].shape[-1])
+    if p + steps > maxlen:
+        raise ValueError(
+            f"prompt ({p}) + steps ({steps}) exceeds the model's "
+            f"maxlen ({maxlen})"
+        )
+    if top_k is not None and not 0 < int(top_k) <= vocab:
+        raise ValueError(
+            f"top_k={top_k} outside (0, vocab={vocab}]"
+        )
+    tv = [v.value for v in model.trainable_variables]
+    ntv = [v.value for v in model.non_trainable_variables]
+    tokens0 = np.zeros((b, maxlen), np.int32)
+    tokens0[:, :p] = prompt
+
+    # the compiled loop is cached ON the model, keyed by everything its
+    # program shape depends on — repeat calls (same prompt shape and
+    # sampling config) hit the cache, and weights ride as ARGUMENTS so
+    # further training never serves stale baked-in constants
+    cache = model.__dict__.setdefault("_elephas_generate_jit", {})
+    cache_key = (b, p, steps, float(temperature), top_k)
+    run = cache.get(cache_key)
+    if run is None:
+
+        def sample_logits(logits, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = logits / temperature
+            if top_k is not None:
+                kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)][:, None]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            return jax.random.categorical(key, scaled, axis=-1).astype(
+                jnp.int32
+            )
+
+        @jax.jit
+        def run(tv, ntv, tokens, key):
+            def step(t, carry):
+                tokens, key = carry
+                logits, _ = model.stateless_call(
+                    tv, ntv, tokens, training=False
+                )
+                key, sub = jax.random.split(key)
+                nxt = sample_logits(logits[:, t - 1], sub)
+                return tokens.at[:, t].set(nxt), key
+
+            tokens, _ = jax.lax.fori_loop(p, p + steps, step, (tokens, key))
+            return tokens
+
+        cache[cache_key] = run
+
+    out = run(tv, ntv, jnp.asarray(tokens0), jax.random.PRNGKey(seed))
+    return np.asarray(out[:, : p + steps])

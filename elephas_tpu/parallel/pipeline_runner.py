@@ -214,17 +214,26 @@ class PipelineRunner:
                     f"reported loss) — remove them or use model_parallel"
                 )
         # attribute scan can't see custom layers calling add_loss() in
-        # call(); probe one forward pass and check the collected losses
+        # call(); trace one ABSTRACT forward (eval_shape — no compile,
+        # no memory: validation must not require the model to fit one
+        # device) and check the collected losses
+        extras = None
         try:
             spec = model.inputs[0]
-            probe = np.zeros(
+            probe = jax.ShapeDtypeStruct(
                 (1,) + tuple(int(d) if d else 1 for d in spec.shape[1:]),
-                dtype=getattr(spec.dtype, "name", spec.dtype),
+                getattr(spec.dtype, "name", spec.dtype),
             )
-            model(probe, training=True)
+            jax.eval_shape(lambda t: model(t, training=True), probe)
             extras = list(model.losses)
-        except Exception:  # exotic inputs: fall back to the attr scan
-            extras = []
+        except Exception as exc:  # pragma: no cover - exotic inputs
+            logger.warning(
+                "pipeline_parallel: could not trace the model to check "
+                "for add_loss penalties (%s); if the model calls "
+                "add_loss() in call(), the penalty will NOT train "
+                "through the stage ring",
+                exc,
+            )
         if extras:
             raise ValueError(
                 "pipeline_parallel: the model produces add_loss "

@@ -228,7 +228,6 @@ class ShardedTrainer(KerasIntrospection):
         self._predict_fn = None
         self._sync_fn = None
         self._canon_fn = None
-        self._replicate_fn = None
         self._state = None  # (tv, ntv, ov) device arrays, live across fits
 
     # -- sharding helpers ----------------------------------------------
@@ -247,21 +246,9 @@ class ShardedTrainer(KerasIntrospection):
         )
 
     def _host(self, leaf):
-        """Device→host full value. Cross-process shards are all-gathered
-        in XLA (reshard to replicated) first — ``device_get`` alone
-        cannot read devices this process does not address."""
-        if not isinstance(leaf, jax.Array) or getattr(
-            leaf, "is_fully_addressable", True
-        ):
-            return np.asarray(leaf)
-        if self._replicate_fn is None:
-            # ONE cached jit wrapper: its compilation cache then hits per
-            # input shape/sharding (a fresh lambda per call would retrace
-            # and recompile the gather for every variable, every time)
-            self._replicate_fn = jax.jit(
-                lambda a: a, out_shardings=NamedSharding(self.mesh, P())
-            )
-        return np.asarray(self._replicate_fn(leaf))
+        """Device→host full value — the shared cross-process read
+        (:meth:`~elephas_tpu.worker.KerasIntrospection._host_read`)."""
+        return self._host_read(leaf)
 
     def _stacked(self, sharding: NamedSharding) -> NamedSharding:
         """Per-replica layout: leading ``[DP]`` axis over 'data', the

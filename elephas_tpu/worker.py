@@ -115,6 +115,23 @@ class KerasIntrospection:
     ``('data', 'model')`` mesh). Subclasses provide ``self.model``."""
 
     model = None  # set by subclass __init__
+    _gather_fn = None  # cached identity-jit replicator (host reads)
+
+    def _host_read(self, leaf) -> np.ndarray:
+        """Full host value of a (possibly sharded) device leaf. When the
+        leaf spans devices this process cannot address, replicate via ONE
+        cached identity jit (an XLA all-gather) first — ``device_get``
+        alone cannot read other processes' shards. Subclasses provide
+        ``self.mesh``."""
+        if not isinstance(leaf, jax.Array) or getattr(
+            leaf, "is_fully_addressable", True
+        ):
+            return np.asarray(leaf)
+        if self._gather_fn is None:
+            self._gather_fn = jax.jit(
+                lambda a: a, out_shardings=NamedSharding(self.mesh, P())
+            )
+        return np.asarray(self._gather_fn(leaf))
 
     def _output_names(self) -> list[str]:
         names = list(getattr(self.model, "output_names", []) or [])
@@ -640,16 +657,9 @@ class MeshRunner(KerasIntrospection):
         )
 
     def _gather(self, leaf) -> np.ndarray:
-        """Full ``[W, ...]`` host value of a worker-sharded leaf; when the
-        leaf spans other processes, replicate via an identity jit (XLA
-        all-gather) so every process can read it."""
-        if getattr(leaf, "is_fully_addressable", True):
-            return np.asarray(leaf)
-        if self._gather_fn is None:
-            self._gather_fn = jax.jit(
-                lambda a: a, out_shardings=NamedSharding(self.mesh, P())
-            )
-        return np.asarray(self._gather_fn(leaf))
+        """Full ``[W, ...]`` host value of a worker-sharded leaf — the
+        shared cross-process read (:meth:`KerasIntrospection._host_read`)."""
+        return self._host_read(leaf)
 
     # -- evaluation ----------------------------------------------------
 
