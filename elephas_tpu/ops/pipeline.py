@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elephas_tpu.parallel.mesh import host_read, put_global
+
 logger = logging.getLogger(__name__)
 
 
@@ -224,7 +226,7 @@ class GPipeTrainer:
         # microbatch spec: [M, mb, ...] rows split over the data axis
         self._mb_spec = P(None, data_axis) if self.dp > 1 else P()
         self._mb_sh = NamedSharding(mesh, self._mb_spec)
-        self.params = jax.device_put(stacked, self._stage_sh)
+        self.params = put_global(stacked, self._stage_sh)
         # optimizer slots mirror the stacked layout; scalar counters
         # replicate
         state_struct = jax.eval_shape(self.optimizer.init, self.params)
@@ -436,7 +438,9 @@ class GPipeTrainer:
                     (M, batch_size // M) + y.shape[1:]
                 )
                 self.params, self.opt_state, loss = self._train_step(
-                    self.params, self.opt_state, xm, ym
+                    self.params, self.opt_state,
+                    put_global(xm, self._mb_sh),
+                    put_global(ym, self._mb_sh),
                 )
                 losses.append(loss)
             self._finish_epoch(
@@ -517,7 +521,9 @@ class GPipeTrainer:
                     xm = self._microbatches(x_flat, need)
                     ym = y_flat.reshape((M, need // M) + y_flat.shape[1:])
                     self.params, self.opt_state, loss = self._train_step(
-                        self.params, self.opt_state, xm, ym
+                        self.params, self.opt_state,
+                        put_global(xm, self._mb_sh),
+                        put_global(ym, self._mb_sh),
                     )
                     losses.append(loss)
             self._finish_epoch(
@@ -551,12 +557,18 @@ class GPipeTrainer:
         nb = max(1, int(np.ceil(n / batch_size)))
         idx = np.arange(nb * batch_size) % n
         # targets unused without loss; dp rows so the data spec splits
-        ym0 = np.zeros((M, self.dp), np.float32)
+        # (staged once — it never changes across batches)
+        ym0_dev = put_global(np.zeros((M, self.dp), np.float32), self._mb_sh)
         outs = []
         for b in range(nb):
             rows = idx[b * batch_size : (b + 1) * batch_size]
             xm = self._microbatches(x[rows], batch_size)
-            res = np.asarray(self._predict_fn(self.params, xm, ym0))
+            res = host_read(
+                self._predict_fn(
+                    self.params, put_global(xm, self._mb_sh), ym0_dev
+                ),
+                self.mesh,
+            )
             # last stage's shard: [M, dp·elems_local]; replica r's rows
             # are the r-th contiguous chunk of each microbatch, so
             # [M, dp, mb_local, ...] flattens back to the input order
@@ -567,7 +579,17 @@ class GPipeTrainer:
             )
         return np.concatenate(outs)[:n]
 
+    def stage_weights_all(self) -> list:
+        """Every stage's parameter pytree from ONE gather of the
+        stacked ``[S, P_max]`` params (cross-process shards all-gather
+        first) — weight syncs walk all stages, so per-stage gathers
+        would move the full parameter set S times."""
+        host = host_read(self.params, self.mesh)
+        return [
+            self._unravels[s](jnp.asarray(host[s][: self._p_sizes[s]]))
+            for s in range(self.S)
+        ]
+
     def stage_weights(self, s: int):
         """Stage ``s``'s parameter pytree (host copy, unflattened)."""
-        flat = np.asarray(self.params[s])[: self._p_sizes[s]]
-        return self._unravels[s](jnp.asarray(flat))
+        return self.stage_weights_all()[s]

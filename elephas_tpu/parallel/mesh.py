@@ -16,11 +16,53 @@ across slices — XLA picks the transport, this module never needs to know.
 from __future__ import annotations
 
 import logging
+import weakref
 
 import jax
-from jax.sharding import Mesh
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger(__name__)
+
+
+def put_global(arr, sharding: NamedSharding):
+    """Host→device under an arbitrary sharding, multi-process safe.
+
+    Every gang process holds the identical full host value (the SPMD
+    contract); each materializes only its addressable shards of the
+    global array — ``device_put`` alone rejects shardings that span
+    devices this process cannot address."""
+    arr = np.asarray(arr)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+# one cached identity-jit replicator per mesh: the jit compilation cache
+# then hits per input shape/sharding (a fresh wrapper per call would
+# retrace and recompile the all-gather every time). Weak keys: a
+# dropped mesh (hyperparam trials lease many) releases its wrapper and
+# compiled executables instead of pinning them for the process lifetime
+_GATHER_FNS: "weakref.WeakKeyDictionary[Mesh, object]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def host_read(leaf, mesh: Mesh) -> np.ndarray:
+    """Device→host full value of a (possibly sharded) leaf. When the
+    leaf spans devices this process cannot address, replicate via an
+    identity jit (an XLA all-gather) first."""
+    if not isinstance(leaf, jax.Array) or getattr(
+        leaf, "is_fully_addressable", True
+    ):
+        return np.asarray(leaf)
+    fn = _GATHER_FNS.get(mesh)
+    if fn is None:
+        fn = jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))
+        _GATHER_FNS[mesh] = fn
+    return np.asarray(fn(leaf))
 
 
 def num_available_workers() -> int:

@@ -285,9 +285,10 @@ class PipelineRunner:
     # -- weight sync ---------------------------------------------------
 
     def _write_back(self) -> None:
-        """Trained stage weights → master model variables."""
-        for s, group in enumerate(self._stage_layers):
-            params = self.trainer.stage_weights(s)
+        """Trained stage weights → master model variables (one gather
+        of the stacked params serves every stage)."""
+        all_params = self.trainer.stage_weights_all()
+        for group, params in zip(self._stage_layers, all_params):
             for i, layer in enumerate(group):
                 for var, val in zip(layer.trainable_variables, params[f"l{i}"]):
                     var.assign(np.asarray(val))

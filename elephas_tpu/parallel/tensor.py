@@ -233,17 +233,11 @@ class ShardedTrainer(KerasIntrospection):
     # -- sharding helpers ----------------------------------------------
 
     def _put_global(self, arr, sharding: NamedSharding):
-        """Host→device under an arbitrary sharding, multi-process safe.
+        """Host→device under an arbitrary sharding, multi-process safe —
+        :func:`elephas_tpu.parallel.mesh.put_global`."""
+        from elephas_tpu.parallel.mesh import put_global
 
-        Every gang process holds the identical full host value (the
-        SPMD contract, as in ``MeshRunner``); each materializes only its
-        addressable shards of the global array."""
-        arr = np.asarray(arr)
-        if jax.process_count() == 1:
-            return jax.device_put(arr, sharding)
-        return jax.make_array_from_callback(
-            arr.shape, sharding, lambda idx: arr[idx]
-        )
+        return put_global(arr, sharding)
 
     def _host(self, leaf):
         """Device→host full value — the shared cross-process read

@@ -115,23 +115,14 @@ class KerasIntrospection:
     ``('data', 'model')`` mesh). Subclasses provide ``self.model``."""
 
     model = None  # set by subclass __init__
-    _gather_fn = None  # cached identity-jit replicator (host reads)
 
     def _host_read(self, leaf) -> np.ndarray:
-        """Full host value of a (possibly sharded) device leaf. When the
-        leaf spans devices this process cannot address, replicate via ONE
-        cached identity jit (an XLA all-gather) first — ``device_get``
-        alone cannot read other processes' shards. Subclasses provide
-        ``self.mesh``."""
-        if not isinstance(leaf, jax.Array) or getattr(
-            leaf, "is_fully_addressable", True
-        ):
-            return np.asarray(leaf)
-        if self._gather_fn is None:
-            self._gather_fn = jax.jit(
-                lambda a: a, out_shardings=NamedSharding(self.mesh, P())
-            )
-        return np.asarray(self._gather_fn(leaf))
+        """Full host value of a (possibly sharded) device leaf —
+        :func:`elephas_tpu.parallel.mesh.host_read` over ``self.mesh``
+        (cross-process shards all-gather in XLA first)."""
+        from elephas_tpu.parallel.mesh import host_read
+
+        return host_read(leaf, self.mesh)
 
     def _output_names(self) -> list[str]:
         names = list(getattr(self.model, "output_names", []) or [])
@@ -347,7 +338,6 @@ class MeshRunner(KerasIntrospection):
         self._epoch_fn = None
         self._eval_fn = None
         self._predict_fn = None
-        self._gather_fn = None
         model.optimizer.build(model.trainable_variables)
 
     # -- state plumbing ------------------------------------------------
