@@ -14,5 +14,6 @@ package holds the ops where hand-scheduling beats the compiler:
 
 from elephas_tpu.ops.flash_attention import flash_attention
 from elephas_tpu.ops.ring_attention import ring_attention
+from elephas_tpu.ops.ulysses import ulysses_attention
 
-__all__ = ["flash_attention", "ring_attention"]
+__all__ = ["flash_attention", "ring_attention", "ulysses_attention"]

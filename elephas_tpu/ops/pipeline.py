@@ -591,5 +591,8 @@ class GPipeTrainer:
         ]
 
     def stage_weights(self, s: int):
-        """Stage ``s``'s parameter pytree (host copy, unflattened)."""
-        return self.stage_weights_all()[s]
+        """Stage ``s``'s parameter pytree (host copy, unflattened;
+        one gather, one unravel — loop via :meth:`stage_weights_all`
+        to amortize the gather across stages)."""
+        host = host_read(self.params, self.mesh)
+        return self._unravels[s](jnp.asarray(host[s][: self._p_sizes[s]]))

@@ -15,8 +15,8 @@ across slices — XLA picks the transport, this module never needs to know.
 
 from __future__ import annotations
 
+import functools
 import logging
-import weakref
 
 import jax
 import numpy as np
@@ -40,14 +40,16 @@ def put_global(arr, sharding: NamedSharding):
     )
 
 
-# one cached identity-jit replicator per mesh: the jit compilation cache
-# then hits per input shape/sharding (a fresh wrapper per call would
-# retrace and recompile the all-gather every time). Weak keys: a
-# dropped mesh (hyperparam trials lease many) releases its wrapper and
-# compiled executables instead of pinning them for the process lifetime
-_GATHER_FNS: "weakref.WeakKeyDictionary[Mesh, object]" = (
-    weakref.WeakKeyDictionary()
-)
+# one cached identity-jit replicator per mesh (the jit compilation
+# cache then hits per input shape/sharding; a fresh wrapper per call
+# would retrace and recompile the all-gather every time). The cache is
+# BOUNDED, not weak: the jitted fn's out_shardings holds the mesh
+# strongly, so weak keys could never evict — lru eviction releases old
+# meshes' wrappers once newer ones (hyperparam trials lease many)
+# displace them.
+@functools.lru_cache(maxsize=8)
+def _gather_fn_for(mesh: Mesh):
+    return jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))
 
 
 def host_read(leaf, mesh: Mesh) -> np.ndarray:
@@ -58,11 +60,7 @@ def host_read(leaf, mesh: Mesh) -> np.ndarray:
         leaf, "is_fully_addressable", True
     ):
         return np.asarray(leaf)
-    fn = _GATHER_FNS.get(mesh)
-    if fn is None:
-        fn = jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))
-        _GATHER_FNS[mesh] = fn
-    return np.asarray(fn(leaf))
+    return np.asarray(_gather_fn_for(mesh)(leaf))
 
 
 def num_available_workers() -> int:

@@ -47,12 +47,14 @@ _SCOPE = threading.local()
 
 
 class _SequenceScope:
-    __slots__ = ("mesh", "data_axis", "seq_axis")
+    __slots__ = ("mesh", "data_axis", "seq_axis", "mechanism")
 
-    def __init__(self, mesh: Mesh, data_axis: str, seq_axis: str):
+    def __init__(self, mesh: Mesh, data_axis: str, seq_axis: str,
+                 mechanism: str = "ring"):
         self.mesh = mesh
         self.data_axis = data_axis
         self.seq_axis = seq_axis
+        self.mechanism = mechanism
 
 
 def active_sequence_scope() -> _SequenceScope | None:
@@ -63,11 +65,18 @@ def active_sequence_scope() -> _SequenceScope | None:
 
 class sequence_parallel_scope:
     """Context manager: route sequence-aware ops (``FlashMHA``) through
-    the ring over ``mesh[seq_axis]`` for the duration."""
+    the sharded attention over ``mesh[seq_axis]`` for the duration.
+    ``mechanism``: ``'ring'`` (ppermute KV rotation, O(S/W) activations,
+    any head count) or ``'ulysses'`` (two all-to-alls around full
+    attention; needs ``num_heads % W == 0``)."""
 
     def __init__(self, mesh: Mesh, data_axis: str = "data",
-                 seq_axis: str = "seq"):
-        self._scope = _SequenceScope(mesh, data_axis, seq_axis)
+                 seq_axis: str = "seq", mechanism: str = "ring"):
+        if mechanism not in ("ring", "ulysses"):
+            raise ValueError(
+                f"mechanism must be 'ring' or 'ulysses', got {mechanism!r}"
+            )
+        self._scope = _SequenceScope(mesh, data_axis, seq_axis, mechanism)
 
     def __enter__(self):
         if not hasattr(_SCOPE, "stack"):
@@ -111,6 +120,21 @@ def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
         raise ValueError(
             f"sequence length {s} must divide over sequence_parallel={sp}"
         )
+    if scope.mechanism == "ulysses":
+        from elephas_tpu.ops.ulysses import ulysses_attention
+
+        # batch shards over 'data' when it tiles (tiny introspection
+        # batches replicate — a layout choice, not a limit)
+        data_axis = scope.data_axis if b % dp == 0 else None
+        spec4 = P(data_axis, None, scope.seq_axis, None)
+        fn4 = functools.partial(
+            ulysses_attention, axis_name=scope.seq_axis, causal=causal,
+            scale=scale,
+        )
+        return jax.shard_map(
+            fn4, mesh=scope.mesh, in_specs=(spec4,) * 3, out_specs=spec4,
+            check_vma=False,
+        )(q, k, v)
     # batch·heads shards over 'data' when it tiles; otherwise (tiny
     # introspection batches, 1-row predict) it replicates — the ring
     # only needs the seq axis, so this is a layout choice, not a limit
@@ -149,10 +173,16 @@ class SequenceShardedTrainer(ShardedTrainer):
         sequence_parallel: int = 1,
         mesh: Mesh | None = None,
         data_parallel: int | None = None,
+        attention: str = "ring",
     ):
         mesh = mesh if mesh is not None else dp_sp_mesh(
             sequence_parallel, data_parallel
         )
+        if attention not in ("ring", "ulysses"):
+            raise ValueError(
+                f"attention must be 'ring' or 'ulysses', got {attention!r}"
+            )
+        self.attention = attention
         super().__init__(
             model, mesh=mesh, rules=[], mode="synchronous", frequency="epoch"
         )
@@ -174,7 +204,9 @@ class SequenceShardedTrainer(ShardedTrainer):
         return any(isinstance(l, cls) for l in model._flatten_layers())
 
     def _scope(self):
-        return sequence_parallel_scope(self.mesh, "data", "seq")
+        return sequence_parallel_scope(
+            self.mesh, "data", "seq", mechanism=self.attention
+        )
 
     # every public entry point runs (and, on first call, TRACES) inside
     # the scope, so FlashMHA sees the mesh whenever jit retraces
@@ -200,10 +232,12 @@ class SequenceParallelRunner(TensorParallelRunner):
     sequence_parallel=N)`` drives the whole L5 surface
     (fit/evaluate/predict/checkpoint/streaming) over the DP×SP mesh."""
 
-    def __init__(self, model, mesh: Mesh):
+    def __init__(self, model, mesh: Mesh, attention: str = "ring"):
         self.model = model
         self.mode = "synchronous"
         self.frequency = "epoch"
         self.mesh = mesh
         self.num_workers = mesh.shape["data"]
-        self.trainer = SequenceShardedTrainer(model, mesh=mesh)
+        self.trainer = SequenceShardedTrainer(
+            model, mesh=mesh, attention=attention
+        )

@@ -49,6 +49,7 @@ class SparkModel:
         pipeline_parallel: int = 1,
         pipeline_microbatches: int = 4,
         sequence_parallel: int = 1,
+        sequence_attention: str = "ring",
         *args,
         **kwargs,
     ):
@@ -87,7 +88,13 @@ class SparkModel:
         self.pipeline_parallel = int(pipeline_parallel)
         self.pipeline_microbatches = int(pipeline_microbatches)
         self.sequence_parallel = int(sequence_parallel)
+        self.sequence_attention = str(sequence_attention)
         self.kwargs = kwargs
+        if self.sequence_attention not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sequence_attention must be 'ring' or 'ulysses', got "
+                f"{sequence_attention!r}"
+            )
 
         active = [
             name
@@ -217,6 +224,7 @@ class SparkModel:
             "pipeline_parallel": self.pipeline_parallel,
             "pipeline_microbatches": self.pipeline_microbatches,
             "sequence_parallel": self.sequence_parallel,
+            "sequence_attention": self.sequence_attention,
         }
 
     # -- parameter server (API parity; see module docstring) -----------
@@ -686,7 +694,8 @@ class SparkModel:
                 )
 
                 self._runner = SequenceParallelRunner(
-                    self._master_network, self.mesh
+                    self._master_network, self.mesh,
+                    attention=self.sequence_attention,
                 )
             else:
                 self._runner = MeshRunner(
@@ -745,4 +754,5 @@ def load_spark_model(file_name: str) -> SparkModel:
         pipeline_parallel=config.get("pipeline_parallel", 1),
         pipeline_microbatches=config.get("pipeline_microbatches", 4),
         sequence_parallel=config.get("sequence_parallel", 1),
+        sequence_attention=config.get("sequence_attention", "ring"),
     )

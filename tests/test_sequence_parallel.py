@@ -68,10 +68,14 @@ def test_scope_nesting_and_ring_guard():
             ring_mha(bad_s, bad_s, bad_s)
 
 
-def test_sp_matches_unsharded_training():
-    """Same seeds, same data: ring-sharded attention must reproduce the
-    unsharded flash math (the ring computes identical online-softmax
-    chunks, just placed across devices) to float tolerance."""
+@pytest.mark.parametrize(
+    "attention,sp,dp",
+    [("ring", 4, 2), ("ulysses", 2, 4)],  # ulysses: heads(2) % sp == 0
+)
+def test_sp_matches_unsharded_training(attention, sp, dp):
+    """Same seeds, same data: sharded attention (ring KV rotation or
+    Ulysses head<->sequence all-to-all) must reproduce the unsharded
+    flash math to float tolerance."""
     maxlen, vocab = 32, 64
     x, y = _marker_task(128, maxlen, vocab, seed=3)
 
@@ -80,8 +84,10 @@ def test_sp_matches_unsharded_training():
     h1 = t1.fit(x, y, epochs=2, batch_size=32)
 
     m2 = _tiny_transformer(seed=7, maxlen=maxlen, vocab=vocab)
-    t2 = SequenceShardedTrainer(m2, sequence_parallel=4)
-    assert dict(t2.mesh.shape) == {"data": 2, "seq": 4}
+    t2 = SequenceShardedTrainer(
+        m2, sequence_parallel=sp, data_parallel=dp, attention=attention
+    )
+    assert dict(t2.mesh.shape) == {"data": dp, "seq": sp}
     h2 = t2.fit(x, y, epochs=2, batch_size=32)
 
     np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=2e-3)
@@ -159,3 +165,28 @@ def test_sequence_parallel_config_roundtrip(tmp_path):
     loaded = load_spark_model(path)
     assert loaded.sequence_parallel == 2
     assert loaded.num_workers == 4
+
+
+def test_spark_model_ulysses_attention(spark_context):
+    """L5: sequence_attention='ulysses' routes FlashMHA through the
+    all-to-all mechanism and round-trips the config."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.utils.rdd_utils import to_simple_rdd
+
+    maxlen, vocab = 32, 32
+    x, y = _marker_task(128, maxlen, vocab, seed=1)
+    model = transformer_classifier(
+        vocab_size=vocab, maxlen=maxlen, num_classes=2,
+        d_model=16, num_heads=2, num_layers=1, dropout=0.0, seed=6,
+        lr=1e-2,
+    )
+    sm = SparkModel(model, sequence_parallel=2,
+                    sequence_attention="ulysses")
+    assert sm.get_config()["sequence_attention"] == "ulysses"
+    rdd = to_simple_rdd(spark_context, x, y)
+    history = sm.fit(rdd, epochs=4, batch_size=32)
+    assert history["loss"][-1] < history["loss"][0]
+    preds = sm.predict(x[:32])
+    assert preds.shape == (32, 2)
+    with pytest.raises(ValueError, match="sequence_attention"):
+        SparkModel(model, sequence_parallel=2, sequence_attention="bogus")
