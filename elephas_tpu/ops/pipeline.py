@@ -579,20 +579,21 @@ class GPipeTrainer:
             )
         return np.concatenate(outs)[:n]
 
+    def _stage_from_host(self, host, s: int):
+        """Unravel stage ``s`` from the gathered ``[S, P_max]`` host
+        params (single source of the padded-flat layout)."""
+        return self._unravels[s](jnp.asarray(host[s][: self._p_sizes[s]]))
+
     def stage_weights_all(self) -> list:
         """Every stage's parameter pytree from ONE gather of the
         stacked ``[S, P_max]`` params (cross-process shards all-gather
         first) — weight syncs walk all stages, so per-stage gathers
         would move the full parameter set S times."""
         host = host_read(self.params, self.mesh)
-        return [
-            self._unravels[s](jnp.asarray(host[s][: self._p_sizes[s]]))
-            for s in range(self.S)
-        ]
+        return [self._stage_from_host(host, s) for s in range(self.S)]
 
     def stage_weights(self, s: int):
         """Stage ``s``'s parameter pytree (host copy, unflattened;
         one gather, one unravel — loop via :meth:`stage_weights_all`
         to amortize the gather across stages)."""
-        host = host_read(self.params, self.mesh)
-        return self._unravels[s](jnp.asarray(host[s][: self._p_sizes[s]]))
+        return self._stage_from_host(host_read(self.params, self.mesh), s)

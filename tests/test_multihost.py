@@ -451,6 +451,26 @@ TP_SCRIPT = textwrap.dedent(
         b"".join(np.ascontiguousarray(w, dtype=np.float32).tobytes()
                  for w in model.get_weights())
     ).hexdigest()
+
+    # async/hogwild TP in the gang: per-replica weight lanes stacked
+    # [DP, ...] and sharded over 'data' ACROSS processes, local steps
+    # vmapped per lane, averaging at the epoch boundary
+    keras.utils.set_random_seed(10)
+    model2 = keras.Sequential([
+        keras.layers.Input((d,)),
+        keras.layers.Dense(32, activation="relu"),
+        keras.layers.Dense(k, activation="softmax"),
+    ])
+    model2.compile(optimizer=keras.optimizers.Adam(1e-2),
+                   loss="sparse_categorical_crossentropy")
+    sm2 = SparkModel(model2, mode="asynchronous", frequency="epoch",
+                     model_parallel=2)
+    h2 = sm2.fit(rdd, epochs=3, batch_size=64)
+    digest2 = hashlib.sha256(
+        b"".join(np.ascontiguousarray(w, dtype=np.float32).tobytes()
+                 for w in model2.get_weights())
+    ).hexdigest()
+
     print("TPRESULT " + json.dumps({
         "process": jax.process_index(),
         "digest": digest,
@@ -459,6 +479,8 @@ TP_SCRIPT = textwrap.dedent(
         "predict_acc": acc,
         "eval_loss": scores[0],
         "eval_acc": scores[1],
+        "async_digest": digest2,
+        "async_loss": h2["loss"][-1],
     }), flush=True)
     """
 )
@@ -483,6 +505,9 @@ def test_two_process_tensor_parallel(tmp_path):
     assert a["final_acc"] > 0.85, a
     assert a["predict_acc"] > 0.85, a
     assert abs(a["eval_loss"] - b["eval_loss"]) < 1e-9, (a, b)
+    # async per-replica lanes across processes converge identically too
+    assert a["async_digest"] == b["async_digest"], (a, b)
+    assert np.isfinite(a["async_loss"]), a
 
 
 SP_SCRIPT = textwrap.dedent(
