@@ -251,6 +251,22 @@ def transformer_lm(
     return model
 
 
+def _sample_logits(logits, key, temperature: float, top_k):
+    """Greedy argmax at temperature 0; else temperature-scaled
+    categorical sampling, optionally truncated to the top_k logits.
+    Shared by the full-recompute and KV-cache decode paths."""
+    import jax
+    import jax.numpy as jnp
+
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
 def generate(
     model,
     prompt,
@@ -258,6 +274,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int | None = None,
     seed: int = 0,
+    kv_cache: bool = False,
 ):
     """Autoregressive sampling from a :func:`transformer_lm` model.
 
@@ -270,9 +287,11 @@ def generate(
     TPU-shaped: ONE jitted program — the sequence stays at the model's
     fixed ``maxlen`` (causal attention makes positions ``>= t`` inert),
     and ``lax.fori_loop`` advances a token at a time writing in place.
-    Recomputes the prefix each step (O(S²·L) like the training path —
-    the flash kernel keeps it MXU-tiled and O(S) memory); a KV-cache
-    decode path is a further optimization, not a semantics change.
+    The default path recomputes the prefix each step (O(S²·L) per
+    token, exactly the training math); ``kv_cache=True`` switches to a
+    cached decode — per-layer K/V caches, one token's compute per step
+    (O(S·L) total) — same greedy outputs, built for
+    :func:`transformer_lm`'s architecture specifically.
     """
     import jax
     import jax.numpy as jnp
@@ -297,6 +316,11 @@ def generate(
     tokens0 = np.zeros((b, maxlen), np.int32)
     tokens0[:, :p] = prompt
 
+    if kv_cache:
+        return _generate_cached(
+            model, tokens0, b, p, steps, temperature, top_k, seed
+        )
+
     # the compiled loop is cached ON the model, keyed by everything its
     # program shape depends on — repeat calls (same prompt shape and
     # sampling config) hit the cache, and weights ride as ARGUMENTS so
@@ -306,17 +330,6 @@ def generate(
     run = cache.get(cache_key)
     if run is None:
 
-        def sample_logits(logits, key):
-            if temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            scaled = logits / temperature
-            if top_k is not None:
-                kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)][:, None]
-                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-            return jax.random.categorical(key, scaled, axis=-1).astype(
-                jnp.int32
-            )
-
         @jax.jit
         def run(tv, ntv, tokens, key):
             def step(t, carry):
@@ -325,7 +338,7 @@ def generate(
                     tv, ntv, tokens, training=False
                 )
                 key, sub = jax.random.split(key)
-                nxt = sample_logits(logits[:, t - 1], sub)
+                nxt = _sample_logits(logits[:, t - 1], sub, temperature, top_k)
                 return tokens.at[:, t].set(nxt), key
 
             tokens, _ = jax.lax.fori_loop(p, p + steps, step, (tokens, key))
@@ -335,3 +348,132 @@ def generate(
 
     out = run(tv, ntv, jnp.asarray(tokens0), jax.random.PRNGKey(seed))
     return np.asarray(out[:, : p + steps])
+
+
+def _generate_cached(model, tokens0, b, p, steps, temperature, top_k, seed):
+    """KV-cache decode for :func:`transformer_lm` models.
+
+    A functional re-implementation of the block math (layernorm → qkv →
+    cached attention → proj; layernorm → exact-gelu MLP; pre-norm
+    residuals) reading the model's variables by path, with per-layer
+    ``[B, S, H, Dh]`` K/V caches: each step computes ONE token's
+    activations and attends over the cache — O(S·L) for the whole
+    generation instead of the default path's O(S²·L). One jitted
+    ``fori_loop`` runs prefill and sampling alike (prompt positions keep
+    their ground-truth token; sampled positions write in place). The
+    compiled loop caches on the model like the default path, weights
+    riding as arguments.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    weights = {v.path: v.value for v in model.trainable_variables}
+    if "tok_embed/embeddings" not in weights or "lm_head/kernel" not in weights:
+        raise ValueError(
+            "kv_cache=True supports models built by transformer_lm "
+            "(variable paths tok_embed/blkN_*/final_ln/lm_head); use "
+            "kv_cache=False for custom architectures"
+        )
+    compute_dtype = getattr(model.dtype_policy, "compute_dtype", "float32")
+    if compute_dtype != "float32":
+        raise ValueError(
+            f"kv_cache decode computes in float32, which would diverge "
+            f"from this model's {compute_dtype} forward (argmax flips "
+            f"where top logits are close) — use kv_cache=False for "
+            f"mixed-precision models"
+        )
+    n_layers = sum(1 for k in weights if k.endswith("_ln1/gamma"))
+    attn0 = model.get_layer("blk0_attn")
+    H, Dh = attn0.num_heads, attn0.head_dim
+    d_model = weights["tok_embed/embeddings"].shape[1]
+    maxlen = tokens0.shape[1]
+    scale = Dh**-0.5
+    total = p + steps
+
+    cache = model.__dict__.setdefault("_elephas_generate_jit", {})
+    cache_key = ("kv", b, p, steps, float(temperature), top_k)
+    run = cache.get(cache_key)
+    if run is None:
+        pos_table = jnp.asarray(_positions(maxlen, d_model))
+
+        def ln(w, h, name):
+            g, bta = w[f"{name}/gamma"], w[f"{name}/beta"]
+            mu = jnp.mean(h, axis=-1, keepdims=True)
+            var = jnp.var(h, axis=-1, keepdims=True)
+            return (h - mu) * jax.lax.rsqrt(var + 1e-6) * g + bta
+
+        def decode_step(w, tok, t, caches):
+            # one token through all blocks, reading/writing K/V caches
+            h = w["tok_embed/embeddings"][tok] + pos_table[t]  # [B, D]
+            new_caches = []
+            for layer in range(n_layers):
+                pre = f"blk{layer}"
+                ck, cv = caches[layer]
+                a = ln(w, h, f"{pre}_ln1")
+                qkv = a @ w[f"{pre}_attn/qkv/kernel"]  # [B, 3·H·Dh]
+                q, k, v = jnp.split(qkv.reshape(b, 3, H, Dh), 3, axis=1)
+                q, k, v = q[:, 0], k[:, 0], v[:, 0]  # [B, H, Dh]
+                ck = ck.at[:, t].set(k)
+                cv = cv.at[:, t].set(v)
+                att = jnp.einsum("bhd,bshd->bhs", q, ck) * scale
+                visible = jnp.arange(maxlen)[None, None, :] <= t
+                att = jax.nn.softmax(
+                    jnp.where(visible, att, -jnp.inf), axis=-1
+                )
+                o = jnp.einsum("bhs,bshd->bhd", att, cv).reshape(b, H * Dh)
+                h = h + (
+                    o @ w[f"{pre}_attn/proj/kernel"]
+                    + w[f"{pre}_attn/proj/bias"]
+                )
+                a2 = ln(w, h, f"{pre}_ln2")
+                # exact gelu: keras Dense(activation="gelu") is
+                # approximate=False; jax.nn.gelu defaults to the tanh
+                # approximation, whose ~3e-3 deviation could flip argmax
+                m = jax.nn.gelu(
+                    a2 @ w[f"{pre}_mlp1/kernel"] + w[f"{pre}_mlp1/bias"],
+                    approximate=False,
+                )
+                h = h + (
+                    m @ w[f"{pre}_mlp2/kernel"] + w[f"{pre}_mlp2/bias"]
+                )
+                new_caches.append((ck, cv))
+            logits = (
+                ln(w, h, "final_ln") @ w["lm_head/kernel"]
+                + w["lm_head/bias"]
+            )
+            return logits, new_caches
+
+        @jax.jit
+        def run(w, tokens, key):
+            caches = [
+                (
+                    jnp.zeros((b, maxlen, H, Dh), jnp.float32),
+                    jnp.zeros((b, maxlen, H, Dh), jnp.float32),
+                )
+                for _ in range(n_layers)
+            ]
+
+            def step(t, carry):
+                tokens, caches, key = carry
+                logits, caches = decode_step(w, tokens[:, t], t, caches)
+                key, sub = jax.random.split(key)
+                nxt = _sample_logits(logits, sub, temperature, top_k)
+                # prompt positions keep their ground-truth token; only
+                # the continuation writes
+                write = t + 1 >= p
+                tokens = jnp.where(
+                    write,
+                    tokens.at[:, jnp.minimum(t + 1, maxlen - 1)].set(nxt),
+                    tokens,
+                )
+                return tokens, caches, key
+
+            tokens, _, _ = jax.lax.fori_loop(
+                0, total - 1, step, (tokens, caches, key)
+            )
+            return tokens
+
+        cache[cache_key] = run
+
+    out = run(weights, jnp.asarray(tokens0), jax.random.PRNGKey(seed))
+    return np.asarray(out[:, :total])

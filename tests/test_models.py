@@ -204,5 +204,14 @@ def test_transformer_lm_generate():
     assert sampled.min() >= 0 and sampled.max() < vocab
     np.testing.assert_array_equal(sampled[:, :4], prompt)  # prompt kept
 
+    # KV-cache decode: one token's compute per step, identical greedy
+    # output to the full-recompute path
+    cached = generate(m, prompt, steps=8, kv_cache=True)
+    np.testing.assert_array_equal(cached, out)
+    s1 = generate(m, prompt, steps=6, temperature=0.8, top_k=3, seed=1,
+                  kv_cache=True)
+    np.testing.assert_array_equal(s1[:, :4], prompt)
+    assert s1.max() < vocab
+
     with pytest.raises(ValueError, match="maxlen"):
         generate(m, prompt, steps=maxlen)
