@@ -89,6 +89,11 @@ def launch(
 
     def stream(i: int, p: subprocess.Popen) -> None:
         for line in p.stdout:
+            if not line.endswith("\n"):
+                # a child's unterminated final line would otherwise merge
+                # with the other process's next line in the combined
+                # stream, corrupting machine-read output (RESULT lines)
+                line += "\n"
             sys.stdout.write(f"[proc {i}] {line}")
             sys.stdout.flush()
 
