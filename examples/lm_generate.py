@@ -1,0 +1,66 @@
+"""Train a tiny decoder LM and sample from it — the generation demo.
+
+The reference has no language-model story at all (its deepest sequence
+model is the IMDB LSTM classifier); this example shows the TPU-native
+extension end to end: data-parallel LM training through ``SparkModel``,
+then autoregressive sampling as one jitted program — full-recompute and
+KV-cache decode paths produce identical greedy output.
+
+The task is learnable in seconds: sequences cycle through a fixed
+4-token period with a random phase; a correct LM continues the period
+from any prompt.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--maxlen", type=int, default=32)
+    p.add_argument("--vocab", type=int, default=16)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--steps", type=int, default=12)
+    args = p.parse_args()
+
+    import elephas_tpu  # noqa: F401  (jax backend before keras)
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate, transformer_lm
+
+    maxlen, vocab, n = args.maxlen, args.vocab, 512
+    rng = np.random.default_rng(0)
+    starts = rng.integers(2, 6, size=n)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2  # cycle 2..5
+    x = seq[:, :-1].astype(np.int32)
+    y = seq[:, 1:].astype(np.int32)
+
+    model = transformer_lm(
+        vocab_size=vocab, maxlen=maxlen, d_model=32, num_heads=2,
+        num_layers=1, dropout=0.0, lr=1e-2, seed=0,
+    )
+    # 4 workers x batch 32: several optimizer steps per epoch even on
+    # big meshes (one 8-worker step per epoch would undertrain)
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4)
+    history = spark_model.fit((x, y), epochs=args.epochs, batch_size=32)
+    print(
+        f"LM loss: {history['loss'][0]:.3f} -> {history['loss'][-1]:.3f}, "
+        f"next-token acc: {history['accuracy'][-1]:.3f}"
+    )
+
+    prompt = np.array([[2, 3, 4, 5], [5, 2, 3, 4]], np.int32)
+    greedy = generate(model, prompt, steps=args.steps)
+    cached = generate(model, prompt, steps=args.steps, kv_cache=True)
+    assert (greedy == cached).all(), "KV-cache decode must match"
+    for row in greedy:
+        print("greedy:", row.tolist())
+        expect = [(row[0] - 2 + i) % 4 + 2 for i in range(len(row))]
+        assert row.tolist() == expect, (row.tolist(), expect)
+    sampled = generate(model, prompt, steps=args.steps, temperature=0.7,
+                       top_k=4, seed=1)
+    print("sampled:", sampled[0].tolist())
+    print("generation OK (full-recompute == kv-cache on greedy)")
+
+
+if __name__ == "__main__":
+    main()

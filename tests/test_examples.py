@@ -69,3 +69,8 @@ def test_imdb_lstm():
 @pytest.mark.slow
 def test_resnet50_tiny():
     run_example("resnet50_imagenet", ["--tiny", "--epochs", "1"])
+
+
+def test_lm_generate():
+    run_example("lm_generate", ["--maxlen", "16", "--epochs", "8",
+                                "--steps", "8"])
