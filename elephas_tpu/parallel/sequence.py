@@ -34,6 +34,7 @@ import logging
 import threading
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from elephas_tpu.parallel.tensor import ShardedTrainer, TensorParallelRunner
@@ -97,6 +98,31 @@ def dp_sp_mesh(sequence_parallel: int, data_parallel: int | None = None) -> Mesh
     return second_axis_mesh(
         sequence_parallel, "seq", data_parallel, label="sequence_parallel"
     )
+
+
+def dp_sp_tp_mesh(
+    sequence_parallel: int,
+    model_parallel: int,
+    data_parallel: int | None = None,
+) -> Mesh:
+    """3-D ``('data', 'seq', 'model')`` mesh: Megatron weight sharding
+    and sequence sharding compose, data replicas fill the rest. Device
+    budget/divisibility rules live in
+    :func:`~elephas_tpu.parallel.tensor.second_axis_mesh` (one copy)."""
+    from elephas_tpu.parallel.tensor import second_axis_mesh
+
+    sp, mp = int(sequence_parallel), int(model_parallel)
+    if sp <= 0 or mp <= 0:
+        raise ValueError(
+            f"sequence_parallel={sequence_parallel} and "
+            f"model_parallel={model_parallel} must be positive"
+        )
+    flat = second_axis_mesh(
+        sp * mp, "cell", data_parallel,
+        label="sequence_parallel×model_parallel",
+    )
+    arr = np.asarray(flat.devices).reshape(flat.shape["data"], sp, mp)
+    return Mesh(arr, ("data", "seq", "model"))
 
 
 def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
@@ -174,17 +200,34 @@ class SequenceShardedTrainer(ShardedTrainer):
         mesh: Mesh | None = None,
         data_parallel: int | None = None,
         attention: str = "ring",
+        model_parallel: int = 1,
     ):
-        mesh = mesh if mesh is not None else dp_sp_mesh(
-            sequence_parallel, data_parallel
-        )
+        self.model_parallel = int(model_parallel)
+        if mesh is None:
+            mesh = (
+                dp_sp_tp_mesh(
+                    sequence_parallel, self.model_parallel, data_parallel
+                )
+                if self.model_parallel > 1
+                else dp_sp_mesh(sequence_parallel, data_parallel)
+            )
         if attention not in ("ring", "ulysses"):
             raise ValueError(
                 f"attention must be 'ring' or 'ulysses', got {attention!r}"
             )
         self.attention = attention
+        if self.model_parallel > 1 or "model" in mesh.shape:
+            # TP×SP: plan Megatron shardings over the 'model' axis while
+            # the scope shards activations over 'seq' — GSPMD reshards
+            # around the attention shard_map, keeping the composition
+            # exact (asserted against the unsharded oracle in tests)
+            self.MODEL_AXIS = "model"  # instance override
+            rules = None  # DEFAULT_RULES
+        else:
+            rules = []  # weights replicate; SP shards activations only
         super().__init__(
-            model, mesh=mesh, rules=[], mode="synchronous", frequency="epoch"
+            model, mesh=mesh, rules=rules, mode="synchronous",
+            frequency="epoch",
         )
         self.sp = self.mesh.shape["seq"]
         if not self._has_sequence_aware_layer(model):
@@ -239,5 +282,6 @@ class SequenceParallelRunner(TensorParallelRunner):
         self.mesh = mesh
         self.num_workers = mesh.shape["data"]
         self.trainer = SequenceShardedTrainer(
-            model, mesh=mesh, attention=attention
+            model, mesh=mesh, attention=attention,
+            model_parallel=mesh.shape.get("model", 1),
         )

@@ -105,11 +105,13 @@ class SparkModel:
             )
             if n > 1
         ]
-        if len(active) > 1:
+        # model_parallel × sequence_parallel compose (3-D
+        # ('data','seq','model') mesh); the pipeline stays exclusive
+        if len(active) > 1 and "pipeline_parallel" in active:
             raise ValueError(
-                f"{' and '.join(active)} are separate strategies here — "
-                f"pick one (composing them is a future extension; each "
-                f"already composes with data parallelism)"
+                f"{' and '.join(active)} cannot compose — the pipeline "
+                f"is depth-exclusive (model/sequence parallelism and "
+                f"data parallelism compose freely)"
             )
         if self.pipeline_parallel > 1:
             import jax
@@ -140,7 +142,7 @@ class SparkModel:
             self.training_histories = []
             return
 
-        if self.model_parallel > 1:
+        if self.model_parallel > 1 and self.sequence_parallel <= 1:
             # models bigger than one chip: 2-D ('data', 'model') mesh —
             # workers are the data-axis replicas (the reference's
             # fit-one-worker ceiling removed; SURVEY.md §2a TP row)
@@ -175,10 +177,25 @@ class SparkModel:
                     "sequence_parallel (synchronous per-step training; "
                     "use frequency='epoch')"
                 )
-            self.mesh = self._dp_submesh(
-                self.sequence_parallel, "sequence_parallel", dp_sp_mesh,
-                num_workers, jax,
-            )
+            if self.model_parallel > 1:
+                # TP×SP: 3-D ('data','seq','model') mesh — Megatron
+                # weight shards and ring/ulysses sequence shards compose
+                from elephas_tpu.parallel.sequence import dp_sp_tp_mesh
+
+                self.mesh = self._dp_submesh(
+                    self.sequence_parallel * self.model_parallel,
+                    "sequence_parallel×model_parallel",
+                    lambda n, data_parallel: dp_sp_tp_mesh(
+                        self.sequence_parallel, self.model_parallel,
+                        data_parallel,
+                    ),
+                    num_workers, jax,
+                )
+            else:
+                self.mesh = self._dp_submesh(
+                    self.sequence_parallel, "sequence_parallel",
+                    dp_sp_mesh, num_workers, jax,
+                )
             self.num_workers = self.mesh.shape["data"]
         else:
             self.mesh = worker_mesh(num_workers)
@@ -682,13 +699,10 @@ class SparkModel:
                     mesh=self.mesh,
                     data_parallel=self.num_workers,
                 )
-            elif self.model_parallel > 1:
-                from elephas_tpu.parallel.tensor import TensorParallelRunner
-
-                self._runner = TensorParallelRunner(
-                    self._master_network, self.mode, self.frequency, self.mesh
-                )
             elif self.sequence_parallel > 1:
+                # before the TP check: TP×SP routes here (the sequence
+                # runner plans model-axis shardings from the 3-D mesh —
+                # TensorParallelRunner would silently skip the ring)
                 from elephas_tpu.parallel.sequence import (
                     SequenceParallelRunner,
                 )
@@ -696,6 +710,12 @@ class SparkModel:
                 self._runner = SequenceParallelRunner(
                     self._master_network, self.mesh,
                     attention=self.sequence_attention,
+                )
+            elif self.model_parallel > 1:
+                from elephas_tpu.parallel.tensor import TensorParallelRunner
+
+                self._runner = TensorParallelRunner(
+                    self._master_network, self.mode, self.frequency, self.mesh
                 )
             else:
                 self._runner = MeshRunner(

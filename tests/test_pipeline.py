@@ -419,7 +419,7 @@ def test_pipeline_parallel_guards(blobs):
     from elephas_tpu import SparkModel
 
     x, y, d, k = blobs
-    with pytest.raises(ValueError, match="pick one"):
+    with pytest.raises(ValueError, match="depth-exclusive"):
         SparkModel(_pp_mlp(d, k), model_parallel=2, pipeline_parallel=2)
     with pytest.raises(ValueError, match="synchronous"):
         SparkModel(_pp_mlp(d, k), mode="asynchronous", pipeline_parallel=2)

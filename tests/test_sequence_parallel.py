@@ -69,13 +69,18 @@ def test_scope_nesting_and_ring_guard():
 
 
 @pytest.mark.parametrize(
-    "attention,sp,dp",
-    [("ring", 4, 2), ("ulysses", 2, 4)],  # ulysses: heads(2) % sp == 0
+    "attention,sp,dp,mp",
+    [
+        ("ring", 4, 2, 1),
+        ("ulysses", 2, 4, 1),  # ulysses: heads(2) % sp == 0
+        ("ring", 2, 2, 2),  # TP×SP: Megatron shards + ring on one mesh
+    ],
 )
-def test_sp_matches_unsharded_training(attention, sp, dp):
+def test_sp_matches_unsharded_training(attention, sp, dp, mp):
     """Same seeds, same data: sharded attention (ring KV rotation or
-    Ulysses head<->sequence all-to-all) must reproduce the unsharded
-    flash math to float tolerance."""
+    Ulysses head<->sequence all-to-all), optionally composed with
+    Megatron weight sharding, must reproduce the unsharded flash math
+    to float tolerance."""
     maxlen, vocab = 32, 64
     x, y = _marker_task(128, maxlen, vocab, seed=3)
 
@@ -85,9 +90,17 @@ def test_sp_matches_unsharded_training(attention, sp, dp):
 
     m2 = _tiny_transformer(seed=7, maxlen=maxlen, vocab=vocab)
     t2 = SequenceShardedTrainer(
-        m2, sequence_parallel=sp, data_parallel=dp, attention=attention
+        m2, sequence_parallel=sp, data_parallel=dp, attention=attention,
+        model_parallel=mp,
     )
-    assert dict(t2.mesh.shape) == {"data": dp, "seq": sp}
+    expect_shape = {"data": dp, "seq": sp}
+    if mp > 1:
+        expect_shape["model"] = mp
+        # the planner actually sharded weights over the model axis
+        assert any(
+            "model" in spec for spec in t2.sharding_summary().values()
+        ), t2.sharding_summary()
+    assert dict(t2.mesh.shape) == expect_shape
     h2 = t2.fit(x, y, epochs=2, batch_size=32)
 
     np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=2e-3)
@@ -143,8 +156,12 @@ def test_sequence_parallel_guards():
     from elephas_tpu import SparkModel
 
     model = _tiny_transformer(seed=0)
-    with pytest.raises(ValueError, match="separate strategies"):
-        SparkModel(model, model_parallel=2, sequence_parallel=2)
+    # model_parallel composes with sequence_parallel (3-D mesh); the
+    # pipeline stays exclusive
+    sm = SparkModel(model, model_parallel=2, sequence_parallel=2)
+    assert dict(sm.mesh.shape) == {"data": 2, "seq": 2, "model": 2}
+    with pytest.raises(ValueError, match="depth-exclusive"):
+        SparkModel(model, pipeline_parallel=2, sequence_parallel=2)
     with pytest.raises(ValueError, match="synchronously"):
         SparkModel(model, mode="asynchronous", sequence_parallel=2)
     with pytest.raises(ValueError, match="local-SGD"):
@@ -190,3 +207,33 @@ def test_spark_model_ulysses_attention(spark_context):
     assert preds.shape == (32, 2)
     with pytest.raises(ValueError, match="sequence_attention"):
         SparkModel(model, sequence_parallel=2, sequence_attention="bogus")
+
+
+def test_spark_model_tp_sp_composition(spark_context):
+    """L5: SparkModel(model_parallel=2, sequence_parallel=2) routes to
+    the SEQUENCE runner (not the TP runner, which would silently skip
+    the ring), plans Megatron shardings over the 3-D mesh's model axis,
+    and matches unsharded training to float tolerance."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.parallel.sequence import SequenceParallelRunner
+    from elephas_tpu.parallel.tensor import ShardedTrainer, dp_tp_mesh
+
+    maxlen, vocab = 32, 64
+    x, y = _marker_task(128, maxlen, vocab, seed=3)
+
+    m1 = _tiny_transformer(seed=7, maxlen=maxlen, vocab=vocab)
+    t1 = ShardedTrainer(m1, mesh=dp_tp_mesh(model_parallel=1, data_parallel=1))
+    h1 = t1.fit(x, y, epochs=2, batch_size=32)
+
+    m2 = _tiny_transformer(seed=7, maxlen=maxlen, vocab=vocab)
+    sm = SparkModel(m2, sequence_parallel=2, model_parallel=2)
+    assert dict(sm.mesh.shape) == {"data": 2, "seq": 2, "model": 2}
+    runner = sm._get_runner()
+    assert isinstance(runner, SequenceParallelRunner), type(runner)
+    summary = runner.trainer.sharding_summary()
+    assert any("model" in spec for spec in summary.values()), summary
+    h2 = sm.fit((x, y), epochs=2, batch_size=32)
+
+    np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=2e-3)
+    for a, b in zip(m1.get_weights(), m2.get_weights()):
+        np.testing.assert_allclose(a, b, atol=2e-3, rtol=2e-3)
