@@ -140,6 +140,12 @@ HasSequenceParallel = _mixin(
     1,
     cap="SequenceParallel",
 )
+HasSequenceAttention = _mixin(
+    "sequence_attention",
+    "SP attention mechanism: 'ring' (ppermute KV) | 'ulysses' (all-to-all).",
+    "ring",
+    cap="SequenceAttention",
+)
 HasEpochs = _mixin("epochs", "Training epochs.", 10)
 HasBatchSize = _mixin("batch_size", "Per-worker batch size.", 32, cap="BatchSize")
 HasVerbosity = _mixin("verbose", "Verbosity 0/1/2.", 0, cap="Verbosity")

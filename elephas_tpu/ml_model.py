@@ -40,6 +40,7 @@ from elephas_tpu.ml.params import (
     HasModelParallel,
     HasPipelineParallel,
     HasSequenceParallel,
+    HasSequenceAttention,
     HasNumberOfClasses,
     HasNumberOfWorkers,
     HasOptimizerConfig,
@@ -60,6 +61,7 @@ class _ElephasParams(
     HasModelParallel,
     HasPipelineParallel,
     HasSequenceParallel,
+    HasSequenceAttention,
     HasEpochs,
     HasBatchSize,
     HasVerbosity,
@@ -136,6 +138,7 @@ class ElephasEstimator(_ElephasParams):
             model_parallel=config.get("model_parallel", 1),
             pipeline_parallel=config.get("pipeline_parallel", 1),
             sequence_parallel=config.get("sequence_parallel", 1),
+            sequence_attention=config.get("sequence_attention", "ring"),
         )
         spark_model.fit(
             rdd,

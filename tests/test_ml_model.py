@@ -281,10 +281,13 @@ def test_estimator_sequence_parallel_param(blobs):
         epochs=3,
         batch_size=32,
         sequence_parallel=2,
+        sequence_attention="ulysses",  # non-default: catches a dropped param
         categorical_labels=False,
         nb_classes=k,
     )
     assert est.getSequenceParallel() == 2
+    assert est.getSequenceAttention() == "ulysses"
+    assert est.get_config()["sequence_attention"] == "ulysses"
     transformer = est.fit(df)
     out = transformer.transform(df)
     assert "prediction" in out.columns
