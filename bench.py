@@ -29,6 +29,7 @@ tiny preset on CPU so the harness is runnable anywhere.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import logging
 import os
@@ -49,6 +50,48 @@ PEAK_BF16 = [
     ("v4", 275e12),
     ("v3", 123e12),
 ]
+
+# Absolute floor on a credible timed window. The BENCH_r03 anomaly was a
+# 0.0s window (block_until_ready returned instantly through the tunnel);
+# no real multi-epoch measurement on any backend completes in under this.
+MIN_CREDIBLE_DT = 0.05
+MEASURE_RETRIES = 3
+
+
+class ImplausibleTiming(RuntimeError):
+    """A timed window that physics rules out (see BENCH_r03.json)."""
+
+
+def require_credible(dt, ips_chip, flops_per_img, peak):
+    """Reject measurements that violate hard physical bounds.
+
+    Two independent gates (round-3 verdict #2 — BENCH_r03.json recorded
+    613,997 img/s at "MFU 7464.7%" from a 0.0s window and nothing
+    stopped it):
+
+    - ``dt`` must exceed an absolute floor: a degenerate/instant timed
+      window is an instrument failure regardless of model size.
+    - implied MFU must be <= 1.0: ``images * flops / peak`` is a hard
+      lower bound on wall-clock, so throughput implying >100% of the
+      chip's peak FLOP/s is impossible, not impressive.
+
+    Raises :class:`ImplausibleTiming`; callers retry then fail loudly —
+    an impossible number must never reach the JSON record.
+    """
+    if not (dt > MIN_CREDIBLE_DT):
+        raise ImplausibleTiming(
+            f"timed window {dt:.4f}s is below the {MIN_CREDIBLE_DT}s "
+            "credibility floor (degenerate timing — device sync returned "
+            "without the work having run)"
+        )
+    if flops_per_img == flops_per_img and peak == peak and peak > 0:
+        implied_mfu = ips_chip * flops_per_img / peak
+        if implied_mfu > 1.0:
+            raise ImplausibleTiming(
+                f"implied MFU {implied_mfu * 100:.1f}% > 100%: "
+                f"{ips_chip:.0f} samples/s/chip x {flops_per_img:.3g} "
+                f"FLOP/sample exceeds the chip's {peak:.3g} FLOP/s peak"
+            )
 
 
 def chip_peak_flops() -> tuple[float, str]:
@@ -118,12 +161,18 @@ def measure_spark_fit(model, x, y, batch_size, epochs, num_workers,
     tv, ntv, ov, _mvs, losses = epoch_fn(tv, ntv, ov, zero_mvs(), xb, yb)
     import jax
 
-    jax.block_until_ready(losses)
+    # warmup barrier: a host FETCH, not block_until_ready — through the
+    # axon tunnel block_until_ready can return while the first
+    # execution (which also absorbs the initial weight/data upload,
+    # observed ~100s) is still in flight, and that work would then land
+    # inside the timed window (the BENCH_r03 class of anomaly, in the
+    # opposite direction)
+    np.asarray(losses)
     log.info("compile+warmup epoch: %.1fs", time.perf_counter() - t0)
     # second warmup: first post-compile epoch consistently runs ~40%
     # slow (allocator/power ramp); steady state starts after it
     tv, ntv, ov, _mvs, losses = epoch_fn(tv, ntv, ov, zero_mvs(), xb, yb)
-    jax.block_until_ready(losses)
+    np.asarray(losses)
 
     if profile_dir:
         trace_ctx = jax.profiler.trace(profile_dir)
@@ -136,17 +185,39 @@ def measure_spark_fit(model, x, y, batch_size, epochs, num_workers,
         for _ in range(epochs):
             tv, ntv, ov, _mvs, losses = epoch_fn(tv, ntv, ov, zero_mvs(), xb, yb)
         jax.block_until_ready(losses)
+        # Forced device->host fetch inside the timed window: np.asarray
+        # cannot return until the final epoch's loss bytes physically
+        # cross the transport, so a sync primitive that lies (the
+        # BENCH_r03 tunnel anomaly: block_until_ready returning
+        # instantly) still cannot produce a zero-width window.
+        final_loss = float(np.asarray(losses).ravel()[-1])
         dt = time.perf_counter() - t0
+    if final_loss != final_loss:
+        raise ImplausibleTiming("final epoch loss is NaN — measured run "
+                                "did not perform credible training work")
+    if not (dt > MIN_CREDIBLE_DT):
+        raise ImplausibleTiming(
+            f"timed window {dt:.4f}s is below the {MIN_CREDIBLE_DT}s "
+            "credibility floor"
+        )
     images = W * nb * batch_size * epochs
     return images / dt, dt
 
 
 def measure_jit_baseline(model, x, y, batch_size, epochs):
-    """Fair single-device floor: hand-written ``jax.jit`` train step over
-    pre-staged device batches (what a careful JAX user would write, with
-    none of this framework around it).
+    """Fair single-device floor: a hand-written ``jax.jit`` EPOCH — one
+    ``lax.scan`` of train steps over pre-staged batches, none of this
+    framework around it.
 
-    Returns (images/sec, flops_per_image from XLA's cost model).
+    A scan, not a Python per-step loop, so the baseline pays one
+    dispatch per epoch exactly like the measured path. Through the axon
+    tunnel a per-step loop measures the transport's per-call latency,
+    not the chip (observed this round: the old 12-dispatch loop
+    reported 25-50 img/s for a chip the epoch program runs at ~2,000
+    img/s — a 40x artifact that would poison ``vs_baseline`` in the
+    opposite direction from BENCH_r03's).
+
+    Returns (images/sec, flops_per_image from XLA's cost model, timed dt).
     """
     import jax
     import jax.numpy as jnp
@@ -164,43 +235,59 @@ def measure_jit_baseline(model, x, y, batch_size, epochs):
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def step(tv, ntv, ov, xb, yb):
+    def step(carry, batch):
+        tv, ntv, ov = carry
+        xb, yb = batch
         (loss, ntv2), grads = grad_fn(tv, ntv, xb, yb)
         tv2, ov2 = optimizer.stateless_apply(ov, grads, tv)
-        return tv2, ntv2, ov2, loss
+        return (tv2, ntv2, ov2), loss
 
-    step_jit = jax.jit(step, donate_argnums=(0, 1, 2))
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_epoch(carry, xs, ys):
+        carry, losses = jax.lax.scan(step, carry, (xs, ys))
+        return carry, losses[-1]
 
     nb = max(1, len(x) // batch_size)
-    batches = [
-        (
-            jax.device_put(x[i * batch_size : (i + 1) * batch_size]),
-            jax.device_put(y[i * batch_size : (i + 1) * batch_size]),
-        )
-        for i in range(nb)
-    ]
+    xs = jax.device_put(
+        np.reshape(x[: nb * batch_size], (nb, batch_size) + x.shape[1:])
+    )
+    ys = jax.device_put(
+        np.reshape(y[: nb * batch_size], (nb, batch_size) + y.shape[1:])
+    )
+    carry = (tv, ntv, ov)
 
-    # XLA's own FLOP count for one optimized train step (trace-backed MFU)
+    # XLA's own FLOP count for one optimized train step (trace-backed
+    # MFU). Lowered as a SINGLE step, not the scan epoch: XLA's cost
+    # model counts a while-loop body once regardless of trip count, so
+    # the epoch program's "flops" is nb× too small (observed exactly
+    # 4x at nb=4). AOT lower+compile only — never executed.
     flops_per_img = float("nan")
     try:
-        cost = step_jit.lower(tv, ntv, ov, *batches[0]).compile().cost_analysis()
+        one_step = jax.jit(lambda carry, xb, yb: step(carry, (xb, yb)))
+        cost = one_step.lower(carry, xs[0], ys[0]).compile().cost_analysis()
         if cost and "flops" in cost:
             flops_per_img = float(cost["flops"]) / batch_size
     except Exception as e:  # pragma: no cover - cost model availability
         log.info("cost_analysis unavailable (%s)", e)
 
     for _ in range(2):  # compile + power-ramp warmup
-        for xb, yb in batches:
-            tv, ntv, ov, loss = step_jit(tv, ntv, ov, xb, yb)
-        jax.block_until_ready(loss)
+        carry, loss = run_epoch(carry, xs, ys)
+    # warmup barrier by host fetch — see measure_spark_fit
+    np.asarray(loss)
 
     t0 = time.perf_counter()
     for _ in range(epochs):
-        for xb, yb in batches:
-            tv, ntv, ov, loss = step_jit(tv, ntv, ov, xb, yb)
+        carry, loss = run_epoch(carry, xs, ys)
     jax.block_until_ready(loss)
+    # same forced host fetch as the headline path (see measure_spark_fit)
+    np.asarray(loss)
     dt = time.perf_counter() - t0
-    return nb * batch_size * epochs / dt, flops_per_img
+    # no floor raise HERE: the caller applies require_credible AFTER the
+    # tuple assignment, so the cost-model FLOP count (timing-free, and
+    # the ammunition for the headline's MFU<=1 gate) survives a
+    # degenerate baseline timing instead of being discarded with it
+    # (code-review r4); only the division needs guarding
+    return nb * batch_size * epochs / max(dt, 1e-9), flops_per_img, dt
 
 
 def measure_stream_fit(model, x, y, batch_size, epochs, block_steps=2):
@@ -324,7 +411,28 @@ def main():
                    help="capture a jax.profiler trace of the timed epochs")
     p.add_argument("--epochs", type=int, default=3)
     p.add_argument("--batch", type=int, default=0, help="override batch size")
+    p.add_argument("--d-model", type=int, default=0,
+                   help="override the transformer preset's d_model")
+    p.add_argument("--layers", type=int, default=0,
+                   help="override the transformer preset's layer count")
+    p.add_argument("--seq", type=int, default=0,
+                   help="override the transformer preset's sequence length")
+    p.add_argument("--flash-block-q", type=int, default=0,
+                   help="flash attention q tile (module default 128)")
+    p.add_argument("--flash-block-k", type=int, default=0,
+                   help="flash attention k tile (module default 128)")
     args = p.parse_args()
+
+    if args.flash_block_q or args.flash_block_k:
+        import elephas_tpu.ops.flash_attention as fa
+
+        if args.flash_block_q:
+            fa.DEFAULT_BLOCK_Q = args.flash_block_q
+        if args.flash_block_k:
+            fa.DEFAULT_BLOCK_K = args.flash_block_k
+        log.info(
+            "flash blocks: q=%d k=%d", fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K
+        )
 
     import jax
 
@@ -345,6 +453,12 @@ def main():
             maxlen, vocab, d_model, layers, batch, nb = 256, 8192, 1024, 4, 64, 4
         else:
             maxlen, vocab, d_model, layers, batch, nb = 32, 256, 64, 1, 8, 4
+        if args.d_model:
+            d_model = args.d_model
+        if args.layers:
+            layers = args.layers
+        if args.seq:
+            maxlen = args.seq
         classes = 2
         unit_scale = maxlen
         make = lambda: transformer_classifier(  # noqa: E731
@@ -380,9 +494,71 @@ def main():
     if args.batch:
         batch = args.batch
     x, y = gen(nb * batch * max(1, n_chips))
-    ips, dt = measure_spark_fit(
-        make(), x, y, batch, args.epochs, None, profile_dir=args.profile_dir
-    )
+    peak, kind = chip_peak_flops()
+
+    # The jit baseline runs FIRST: its XLA cost-model FLOP count arms the
+    # MFU<=1 credibility gate before the headline is timed (r3 verdict #1).
+    vs_baseline = 1.0
+    flops_per_img = float("nan")
+    base_ips = float("nan")
+    if not args.no_baseline:
+        try:
+            base_epochs = args.epochs
+            for attempt in range(1, MEASURE_RETRIES + 1):
+                try:
+                    base_ips, flops_per_img, bdt = measure_jit_baseline(
+                        make(), x[: nb * batch], y[: nb * batch], batch,
+                        base_epochs,
+                    )
+                    require_credible(bdt, base_ips, flops_per_img, peak)
+                    log.info(
+                        "hand-written jax.jit baseline: %.1f img/s (1 chip)",
+                        base_ips,
+                    )
+                    break
+                except ImplausibleTiming as e:
+                    log.warning(
+                        "jit baseline attempt %d/%d implausible: %s",
+                        attempt, MEASURE_RETRIES, e,
+                    )
+                    # the FLOP count is cost-model output (timing-free),
+                    # so keep it for the headline gate; only the
+                    # throughput claim is discarded
+                    base_ips = float("nan")
+                    if "credibility floor" in str(e):
+                        base_epochs *= 8  # see the headline loop
+        except Exception as e:  # pragma: no cover
+            log.info("jit baseline failed (%s); vs_baseline=1.0", e)
+
+    ips = dt = None
+    epochs = args.epochs
+    for attempt in range(1, MEASURE_RETRIES + 1):
+        try:
+            ips, dt = measure_spark_fit(
+                make(), x, y, batch, epochs, None,
+                profile_dir=args.profile_dir,
+            )
+            require_credible(dt, ips / n_chips, flops_per_img, peak)
+            break
+        except ImplausibleTiming as e:
+            log.warning(
+                "headline attempt %d/%d implausible: %s",
+                attempt, MEASURE_RETRIES, e,
+            )
+            if "credibility floor" in str(e):
+                # disambiguate genuinely-tiny workloads from a lying
+                # device sync: real work scales linearly with epochs and
+                # crosses the floor; a degenerate timed window stays ~0
+                # no matter how many epochs are queued
+                epochs *= 8
+                log.info("scaling to %d epochs to exceed the floor", epochs)
+    else:
+        log.error(
+            "no credible headline measurement in %d attempts — refusing "
+            "to emit a JSON record (see BENCH_r03.json for why)",
+            MEASURE_RETRIES,
+        )
+        sys.exit(1)
     ips_chip = ips / n_chips
     if args.profile_dir:
         log.info("profiler trace written to %s", args.profile_dir)
@@ -390,21 +566,9 @@ def main():
         "SparkModel path: %.1f img/s total, %.1f img/s/chip (%.1fs)",
         ips, ips_chip, dt,
     )
+    if base_ips == base_ips:
+        vs_baseline = ips_chip / base_ips
 
-    vs_baseline = 1.0
-    flops_per_img = float("nan")
-    base_ips = float("nan")
-    if not args.no_baseline:
-        try:
-            base_ips, flops_per_img = measure_jit_baseline(
-                make(), x[: nb * batch], y[: nb * batch], batch, args.epochs
-            )
-            log.info("hand-written jax.jit baseline: %.1f img/s (1 chip)", base_ips)
-            vs_baseline = ips_chip / base_ips
-        except Exception as e:  # pragma: no cover
-            log.info("jit baseline failed (%s); vs_baseline=1.0", e)
-
-    peak, kind = chip_peak_flops()
     mfu = float("nan")
     if flops_per_img == flops_per_img and peak == peak:  # both non-nan
         mfu = ips_chip * flops_per_img / peak
@@ -480,6 +644,13 @@ def main():
         out["glue_keras_fit"] = round(glue_ips * unit_scale, 2)
     if args.profile_dir:
         out["profile_dir"] = args.profile_dir
+    # last-line defence: nothing physically impossible reaches stdout
+    if out.get("mfu", 0.0) > 1.0 or not (dt > MIN_CREDIBLE_DT):
+        log.error(
+            "emit-time sanity gate tripped (mfu=%s, dt=%.4fs); no JSON",
+            out.get("mfu"), dt,
+        )
+        sys.exit(1)
     print(json.dumps(out))
 
 

@@ -1,0 +1,139 @@
+"""The benchmark's credibility gate (round-3 verdict #1).
+
+``BENCH_r03.json`` recorded 613,997 img/s/chip — "MFU: 7464.7%" — from a
+0.0s timed window, because a transport anomaly made ``block_until_ready``
+return instantly and nothing in ``bench.py`` sanity-checked the number.
+These tests pin the contract: a poisoned timing path provably aborts and
+an impossible number can never reach the JSON record.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+class TestRequireCredible:
+    def test_sane_measurement_passes(self):
+        # round-3 re-measured reality: ~2,193 img/s, 4.1 GFLOP/img, v5e peak
+        bench.require_credible(
+            dt=1.4, ips_chip=2193.0, flops_per_img=24e9, peak=197e12
+        )
+
+    def test_zero_width_window_rejected(self):
+        # the exact BENCH_r03 failure shape: dt == 0.0
+        with pytest.raises(bench.ImplausibleTiming, match="credibility floor"):
+            bench.require_credible(
+                dt=0.0, ips_chip=613997.0, flops_per_img=24e9, peak=197e12
+            )
+
+    def test_subfloor_window_rejected(self):
+        with pytest.raises(bench.ImplausibleTiming, match="credibility floor"):
+            bench.require_credible(
+                dt=bench.MIN_CREDIBLE_DT / 2, ips_chip=100.0,
+                flops_per_img=1e9, peak=197e12,
+            )
+
+    def test_impossible_mfu_rejected(self):
+        # 613,997 img/s x 24 GFLOP/img = 7,464% of v5e peak
+        with pytest.raises(bench.ImplausibleTiming, match="MFU"):
+            bench.require_credible(
+                dt=1.4, ips_chip=613997.0, flops_per_img=24e9, peak=197e12
+            )
+
+    def test_mfu_gate_needs_flops_and_peak(self):
+        # NaN flops (e.g. --no-baseline) disables only the MFU gate;
+        # the absolute dt floor still applies
+        bench.require_credible(
+            dt=1.0, ips_chip=1e9, flops_per_img=float("nan"), peak=197e12
+        )
+        bench.require_credible(
+            dt=1.0, ips_chip=1e9, flops_per_img=24e9, peak=float("nan")
+        )
+        with pytest.raises(bench.ImplausibleTiming):
+            bench.require_credible(
+                dt=0.0, ips_chip=1.0, flops_per_img=float("nan"),
+                peak=float("nan"),
+            )
+
+    def test_exact_peak_passes_above_fails(self):
+        # boundary: implied MFU 1.0 is allowed, epsilon above is not
+        peak, flops = 197e12, 1e9
+        bench.require_credible(
+            dt=1.0, ips_chip=peak / flops, flops_per_img=flops, peak=peak
+        )
+        with pytest.raises(bench.ImplausibleTiming):
+            bench.require_credible(
+                dt=1.0, ips_chip=peak / flops * 1.01, flops_per_img=flops,
+                peak=peak,
+            )
+
+
+_POISONED_RUN = """
+import sys, types, itertools
+sys.path.insert(0, {repo!r})
+import bench
+
+# Poison the clock exactly as the round-3 anomaly did: perf_counter
+# freezes, so every timed window measures ~0.0s while the work "runs".
+import time
+frozen = time.perf_counter()
+time.perf_counter = lambda: frozen
+
+sys.argv = ["bench.py", "--preset", "tiny", "--epochs", "1"]
+bench.main()
+"""
+
+
+class TestPoisonedTimingAborts:
+    def test_frozen_clock_never_emits_json(self, tmp_path):
+        """End-to-end: freeze perf_counter (the r3 anomaly made every
+        timed window 0-width) and assert bench exits non-zero with no
+        JSON line on stdout."""
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                   KERAS_BACKEND="jax")
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             _POISONED_RUN.format(repo=os.path.dirname(
+                 os.path.dirname(os.path.abspath(__file__))))],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        assert proc.returncode != 0, (
+            f"poisoned bench run must fail loudly; stdout={proc.stdout!r}"
+        )
+        for line in proc.stdout.splitlines():
+            assert not line.startswith("{"), (
+                f"poisoned run emitted a JSON record: {line}"
+            )
+        assert "implausible" in proc.stderr.lower() or \
+            "credible" in proc.stderr.lower()
+
+
+class TestBenchJsonContract:
+    def test_tiny_preset_emits_sane_record(self):
+        """`python bench.py` on CPU still produces the one-line JSON
+        contract, with the guard live (mfu<=1, dt above floor)."""
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                   KERAS_BACKEND="jax")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--preset", "tiny", "--epochs", "1"],
+            capture_output=True, text=True, timeout=900, env=env, cwd=repo,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+        assert rec["value"] > 0
+        if "mfu" in rec:
+            assert 0 < rec["mfu"] <= 1.0
