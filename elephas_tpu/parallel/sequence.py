@@ -142,6 +142,13 @@ def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
     b, h, s, d = q.shape
     sp = scope.mesh.shape[scope.seq_axis]
     dp = scope.mesh.shape[scope.data_axis]
+    # under TP×SP the 'model' axis shards the HEAD dimension of the
+    # attention core too (heads are independent, so splitting them over
+    # TP ranks changes the layout, not the math) — without this, q/k/v
+    # replicate across TP ranks inside the shard_map and model_parallel
+    # buys no attention speedup (r3 advisor finding)
+    mp_axis = "model" if "model" in scope.mesh.shape else None
+    mp = scope.mesh.shape.get("model", 1)
     if s % sp:
         raise ValueError(
             f"sequence length {s} must divide over sequence_parallel={sp}"
@@ -150,9 +157,21 @@ def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
         from elephas_tpu.ops.ulysses import ulysses_attention
 
         # batch shards over 'data' when it tiles (tiny introspection
-        # batches replicate — a layout choice, not a limit)
+        # batches replicate — a layout choice, not a limit); heads shard
+        # over 'model' when each TP rank's slice still tiles over seq
         data_axis = scope.data_axis if b % dp == 0 else None
-        spec4 = P(data_axis, None, scope.seq_axis, None)
+        head_axis = (
+            mp_axis if mp > 1 and h % mp == 0 and (h // mp) % sp == 0
+            else None
+        )
+        if data_axis is None and dp > 1:
+            logger.info(
+                "ulysses: batch %d does not tile over data=%d — "
+                "activations replicate across the data axis for this "
+                "call (correct, but a multi-x memory/throughput cost)",
+                b, dp,
+            )
+        spec4 = P(data_axis, head_axis, scope.seq_axis, None)
         fn4 = functools.partial(
             ulysses_attention, axis_name=scope.seq_axis, causal=causal,
             scale=scale,
@@ -161,11 +180,24 @@ def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
             fn4, mesh=scope.mesh, in_specs=(spec4,) * 3, out_specs=spec4,
             check_vma=False,
         )(q, k, v)
-    # batch·heads shards over 'data' when it tiles; otherwise (tiny
-    # introspection batches, 1-row predict) it replicates — the ring
-    # only needs the seq axis, so this is a layout choice, not a limit
-    data_axis = scope.data_axis if (b * h) % dp == 0 else None
-    spec = P(data_axis, scope.seq_axis, None)
+    # batch·heads shards over 'data' (and 'model' under TP×SP) when it
+    # tiles; otherwise (tiny introspection batches, 1-row predict) it
+    # replicates — the ring only needs the seq axis, so this is a
+    # layout choice, not a limit
+    if mp > 1 and (b * h) % (dp * mp) == 0:
+        lead_axis = (scope.data_axis, mp_axis)
+    elif (b * h) % dp == 0:
+        lead_axis = scope.data_axis
+    else:
+        lead_axis = None
+    if lead_axis is None and dp > 1:
+        logger.info(
+            "ring: batch·heads %d does not tile over data=%d — "
+            "activations replicate across the data axis for this call "
+            "(correct, but a multi-x memory/throughput cost)",
+            b * h, dp,
+        )
+    spec = P(lead_axis, scope.seq_axis, None)
     fn = functools.partial(
         ring_attention, axis_name=scope.seq_axis, causal=causal, scale=scale
     )
@@ -177,6 +209,140 @@ def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
         q.reshape(b * h, s, d), k.reshape(b * h, s, d), v.reshape(b * h, s, d)
     )
     return out.reshape(b, h, s, d)
+
+
+def patch_stock_attention(model) -> int:
+    """Make keras' stock attention layers sequence-parallel-aware.
+
+    The reference's promise is "bring any compiled Keras model"
+    (SURVEY.md §2, `[U] elephas/spark_model.py`); round 3 kept it under
+    SP only for the in-tree ``FlashMHA``. This routes the attention core
+    of stock ``keras.layers.MultiHeadAttention`` /
+    ``GroupedQueryAttention`` through :func:`ring_mha` whenever a
+    sequence scope is active, by patching two instance methods:
+
+    - ``_compute_attention_mask``: under the scope, ``use_causal_mask``
+      is absorbed into the sharded kernel's analytic causal handling
+      instead of densifying a ``[T, S]`` mask across seq shards;
+    - ``_compute_attention``: under the scope, the projected
+      ``[B, S, N, H]`` heads run through the ring / Ulysses
+      ``shard_map`` (keras' own einsum attention otherwise).
+
+    Outside a scope the layers behave exactly as stock keras (the
+    original methods are called), so patched models remain ordinary
+    Keras models — save/summary/inference all unchanged. Falls back to
+    the stock path (replicated attention; training still correct) for
+    explicit attention masks, attention dropout, returned scores, or
+    non-4D heads, logging once per layer.
+
+    Returns the number of stock attention layers now sequence-aware.
+    """
+    import keras
+
+    targets = [keras.layers.MultiHeadAttention]
+    for name in ("GroupQueryAttention", "GroupedQueryAttention"):
+        if hasattr(keras.layers, name):  # renamed across keras versions
+            targets.append(getattr(keras.layers, name))
+    targets = tuple(targets)
+    n = 0
+    for layer in model._flatten_layers():
+        if not isinstance(layer, targets):
+            continue
+        n += 1
+        if getattr(layer, "_elephas_sp_patched", False):
+            continue
+        _patch_attention_layer(layer)
+    return n
+
+
+def _patch_attention_layer(layer):
+    import inspect
+
+    import jax.numpy as jnp
+
+    orig_mask = layer._compute_attention_mask
+    orig_compute = layer._compute_attention
+    # MHA's _compute_attention takes return_attention_scores
+    # positionally; GQA's reads self._return_attention_scores instead
+    orig_takes_scores = (
+        "return_attention_scores"
+        in inspect.signature(orig_compute).parameters
+    )
+
+    def patched_mask(query, value, query_mask=None, value_mask=None,
+                     key_mask=None, attention_mask=None,
+                     use_causal_mask=False):
+        if (active_sequence_scope() is not None and use_causal_mask
+                and query_mask is None and value_mask is None
+                and key_mask is None and attention_mask is None):
+            layer._elephas_sp_causal = True
+            return None
+        layer._elephas_sp_causal = False
+        return orig_mask(
+            query, value, query_mask=query_mask, value_mask=value_mask,
+            key_mask=key_mask, attention_mask=attention_mask,
+            use_causal_mask=use_causal_mask,
+        )
+
+    def patched_compute(query, key, value, attention_mask=None,
+                        training=None, return_attention_scores=False):
+        scope = active_sequence_scope()
+        wants_scores = return_attention_scores or getattr(
+            layer, "_return_attention_scores", False
+        )
+        dropout = getattr(layer, "_dropout", None)
+        if dropout is None:
+            dropout = getattr(layer, "dropout", 0.0)
+        if (scope is None or attention_mask is not None or wants_scores
+                or dropout > 0.0 or len(query.shape) != 4
+                # ring/ulysses assume a self-attention-shaped core:
+                # equal q/kv sequence lengths, one head dim throughout
+                or query.shape[1] != key.shape[1]
+                or query.shape[-1] != value.shape[-1]):
+            if (attention_mask is None
+                    and getattr(layer, "_elephas_sp_causal", False)):
+                # patched_mask absorbed use_causal_mask expecting the
+                # sharded kernel to apply causality analytically; on
+                # fallback the stock path MUST get the mask back or it
+                # silently attends bidirectionally (code-review r4)
+                attention_mask = jnp.tril(
+                    jnp.ones(
+                        (query.shape[1], key.shape[1]), dtype="bool"
+                    )
+                )
+            if scope is not None and not getattr(
+                layer, "_elephas_sp_fallback_logged", False
+            ):
+                layer._elephas_sp_fallback_logged = True
+                logger.info(
+                    "%s: stock attention path under sequence parallelism "
+                    "(explicit mask, attention dropout, or returned "
+                    "scores) — attention replicates across seq shards "
+                    "for this layer; training stays correct",
+                    layer.name,
+                )
+            if orig_takes_scores:
+                return orig_compute(query, key, value, attention_mask,
+                                    training, return_attention_scores)
+            return orig_compute(query, key, value,
+                                attention_mask=attention_mask,
+                                training=training)
+        inv_scale = getattr(layer, "_inverse_sqrt_key_dim", None)
+        if inv_scale is None:
+            inv_scale = layer._inverse_sqrt_head_dim
+        out = ring_mha(
+            jnp.moveaxis(query, 1, 2),  # [B, T, N, H] -> [B, N, T, H]
+            jnp.moveaxis(key, 1, 2),
+            jnp.moveaxis(value, 1, 2),
+            causal=bool(getattr(layer, "_elephas_sp_causal", False)),
+            scale=float(inv_scale),
+            scope=scope,
+        )
+        return jnp.moveaxis(out, 1, 2), None
+
+    layer._compute_attention_mask = patched_mask
+    layer._compute_attention = patched_compute
+    layer._elephas_sp_patched = True
 
 
 class SequenceShardedTrainer(ShardedTrainer):
@@ -211,6 +377,12 @@ class SequenceShardedTrainer(ShardedTrainer):
                 if self.model_parallel > 1
                 else dp_sp_mesh(sequence_parallel, data_parallel)
             )
+        if "seq" not in mesh.shape:
+            raise ValueError(
+                "SequenceShardedTrainer needs a mesh with a 'seq' axis; "
+                f"got axes {tuple(mesh.shape)} — build one with "
+                "dp_sp_mesh()/dp_sp_tp_mesh() or add a 'seq' axis"
+            )
         if attention not in ("ring", "ulysses"):
             raise ValueError(
                 f"attention must be 'ring' or 'ulysses', got {attention!r}"
@@ -230,12 +402,13 @@ class SequenceShardedTrainer(ShardedTrainer):
             frequency="epoch",
         )
         self.sp = self.mesh.shape["seq"]
-        if not self._has_sequence_aware_layer(model):
+        n_stock = patch_stock_attention(model)
+        if not self._has_sequence_aware_layer(model) and not n_stock:
             logger.warning(
                 "sequence_parallel=%d but the model has no sequence-aware "
-                "attention layer (FlashMHA) — training stays correct, but "
-                "nothing rings over the seq axis; activations may simply "
-                "replicate across it",
+                "attention layer (FlashMHA or stock keras MHA/GQA) — "
+                "training stays correct, but nothing rings over the seq "
+                "axis; activations may simply replicate across it",
                 self.sp,
             )
 

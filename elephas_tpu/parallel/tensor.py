@@ -59,6 +59,13 @@ DEFAULT_RULES: list[tuple[str, callable]] = [
     (r"(qkv|mlp1|lm_head|head)/kernel$", lambda m: P(None, m)),
     (r"(proj|mlp2)/kernel$", lambda m: P(m, None)),
     (r"embedding.*/embeddings$|tok_embed.*/embeddings$", lambda m: P(None, m)),
+    # stock keras MultiHeadAttention / GroupedQueryAttention: EinsumDense
+    # sublayers named query/key/value ([D, N, H] kernels, [N, H] biases)
+    # and attention_output ([N, H, D]) — shard the HEAD axis, Megatron-
+    # paired so per-head activations stay sharded through the core
+    (r"/(query|key|value)/kernel$", lambda m: P(None, m, None)),
+    (r"/(query|key|value)/bias$", lambda m: P(m, None)),
+    (r"/attention_output/kernel$", lambda m: P(m, None, None)),
     (r"dense[^/]*/kernel$", lambda m: P(None, m)),
     # MoeFFN expert weights [E, ...] shard over experts — GSPMD places
     # the token all-to-all, i.e. expert parallelism on the model axis
@@ -86,7 +93,11 @@ def second_axis_mesh(
             f"{label}={n} must divide the device count "
             f"({len(devices)}) — or pass data_parallel explicitly"
         )
-    dp = data_parallel or len(devices) // n
+    if data_parallel is not None and data_parallel <= 0:
+        raise ValueError(
+            f"data_parallel must be positive, got {data_parallel}"
+        )
+    dp = data_parallel if data_parallel is not None else len(devices) // n
     if dp * n > len(devices):
         raise ValueError(
             f"data_parallel×{label} = {dp}×{n} exceeds "

@@ -24,10 +24,10 @@ from elephas_tpu.parallel.sequence import (
 from elephas_tpu.parallel.tensor import ShardedTrainer, dp_tp_mesh
 
 
-def _tiny_transformer(seed=0, maxlen=32, vocab=64):
+def _tiny_transformer(seed=0, maxlen=32, vocab=64, heads=2):
     return transformer_classifier(
         vocab_size=vocab, maxlen=maxlen, num_classes=2,
-        d_model=16, num_heads=2, num_layers=1, dropout=0.0, seed=seed,
+        d_model=16, num_heads=heads, num_layers=1, dropout=0.0, seed=seed,
     )
 
 
@@ -69,14 +69,17 @@ def test_scope_nesting_and_ring_guard():
 
 
 @pytest.mark.parametrize(
-    "attention,sp,dp,mp",
+    "attention,sp,dp,mp,heads",
     [
-        ("ring", 4, 2, 1),
-        ("ulysses", 2, 4, 1),  # ulysses: heads(2) % sp == 0
-        ("ring", 2, 2, 2),  # TP×SP: Megatron shards + ring on one mesh
+        ("ring", 4, 2, 1, 2),
+        ("ulysses", 2, 4, 1, 2),  # ulysses: heads(2) % sp == 0
+        ("ring", 2, 2, 2, 2),  # TP×SP: Megatron shards + ring on one mesh
+        # TP×SP ulysses with the head axis sharded over 'model'
+        # (heads % mp == 0 and heads/mp % sp == 0 → head_axis engages)
+        ("ulysses", 2, 2, 2, 4),
     ],
 )
-def test_sp_matches_unsharded_training(attention, sp, dp, mp):
+def test_sp_matches_unsharded_training(attention, sp, dp, mp, heads):
     """Same seeds, same data: sharded attention (ring KV rotation or
     Ulysses head<->sequence all-to-all), optionally composed with
     Megatron weight sharding, must reproduce the unsharded flash math
@@ -84,11 +87,11 @@ def test_sp_matches_unsharded_training(attention, sp, dp, mp):
     maxlen, vocab = 32, 64
     x, y = _marker_task(128, maxlen, vocab, seed=3)
 
-    m1 = _tiny_transformer(seed=7, maxlen=maxlen, vocab=vocab)
+    m1 = _tiny_transformer(seed=7, maxlen=maxlen, vocab=vocab, heads=heads)
     t1 = ShardedTrainer(m1, mesh=dp_tp_mesh(model_parallel=1, data_parallel=1))
     h1 = t1.fit(x, y, epochs=2, batch_size=32)
 
-    m2 = _tiny_transformer(seed=7, maxlen=maxlen, vocab=vocab)
+    m2 = _tiny_transformer(seed=7, maxlen=maxlen, vocab=vocab, heads=heads)
     t2 = SequenceShardedTrainer(
         m2, sequence_parallel=sp, data_parallel=dp, attention=attention,
         model_parallel=mp,
@@ -168,6 +171,12 @@ def test_sequence_parallel_guards():
         SparkModel(model, frequency="fit", sequence_parallel=2)
     with pytest.raises(ValueError, match="exceeds"):
         SparkModel(model, sequence_parallel=16)
+    # an explicit mesh without a 'seq' axis fails up front with a
+    # descriptive error, not a bare KeyError (r3 advisor finding)
+    with pytest.raises(ValueError, match="'seq' axis"):
+        SequenceShardedTrainer(model, mesh=dp_tp_mesh(model_parallel=2))
+    with pytest.raises(ValueError, match="positive"):
+        dp_sp_mesh(sequence_parallel=2, data_parallel=0)
 
 
 def test_sequence_parallel_config_roundtrip(tmp_path):

@@ -1,0 +1,194 @@
+"""Sequence/tensor parallelism for models built ONLY from stock Keras
+layers (r3 verdict missing #3).
+
+The reference's core promise is "bring any compiled Keras model"
+(SURVEY.md §2, `[U] elephas/spark_model.py`). Round 3 kept it under
+SP/TP only for zoo-style models (in-tree ``FlashMHA``, in-tree variable
+names); these tests pin the round-4 fix: a stock
+``keras.layers.MultiHeadAttention`` / ``GroupedQueryAttention`` model
+rings over the seq axis (via ``patch_stock_attention``) and Megatron-
+shards over the model axis (via the EinsumDense planner rules), both to
+oracle parity, with the "sharded NOTHING" / no-FlashMHA warnings gone.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import keras
+
+from elephas_tpu.parallel.sequence import (
+    SequenceShardedTrainer,
+    patch_stock_attention,
+)
+from elephas_tpu.parallel.tensor import ShardedTrainer, dp_tp_mesh
+
+
+def _marker_task(n, maxlen, vocab, seed=0):
+    """Label = which half of the sequence carries marker token 1 — a
+    shard-local model cannot solve it; attention must cross shards."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, size=n).astype(np.int32)
+    x = rng.integers(4, vocab, size=(n, maxlen)).astype(np.int32)
+    pos = rng.integers(0, maxlen // 2, size=n) + np.where(
+        y == 1, maxlen // 2, 0
+    )
+    x[np.arange(n), pos] = 1
+    return x, y
+
+
+def _stock_model(seed=0, maxlen=32, vocab=64, heads=2, causal=False,
+                 gqa=False, dropout=0.0):
+    """A transformer block from STOCK keras layers only — no in-tree
+    FlashMHA, no zoo naming conventions."""
+    keras.utils.set_random_seed(seed)
+    inp = keras.Input((maxlen,), dtype="int32")
+    h = keras.layers.Embedding(vocab, 16, name="embed")(inp)
+    if gqa:
+        att = keras.layers.GroupQueryAttention(
+            head_dim=8, num_query_heads=4, num_key_value_heads=2,
+            name="att", dropout=dropout,
+        )
+    else:
+        att = keras.layers.MultiHeadAttention(
+            num_heads=heads, key_dim=8, name="att", dropout=dropout
+        )
+    a = att(h, h, use_causal_mask=causal)
+    h = keras.layers.LayerNormalization(name="ln1")(h + a)
+    m = keras.layers.Dense(32, activation="relu", name="up")(h)
+    m = keras.layers.Dense(16, name="down")(m)
+    h = keras.layers.LayerNormalization(name="ln2")(h + m)
+    h = keras.layers.GlobalAveragePooling1D()(h)
+    out = keras.layers.Dense(2, activation="softmax", name="cls")(h)
+    model = keras.Model(inp, out)
+    model.compile(
+        optimizer=keras.optimizers.Adam(5e-3),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    return model
+
+
+def _oracle(seed, **kw):
+    m = _stock_model(seed=seed, **kw)
+    t = ShardedTrainer(m, mesh=dp_tp_mesh(model_parallel=1, data_parallel=1))
+    return m, t
+
+
+@pytest.mark.parametrize(
+    "attention,causal,gqa",
+    [
+        ("ring", False, False),
+        ("ring", True, False),  # use_causal_mask -> analytic ring causality
+        ("ulysses", False, False),
+        ("ring", False, True),  # GroupedQueryAttention
+    ],
+)
+def test_stock_attention_sp_matches_unsharded(attention, causal, gqa):
+    maxlen, vocab = 32, 64
+    x, y = _marker_task(128, maxlen, vocab, seed=3)
+
+    m1, t1 = _oracle(7, maxlen=maxlen, vocab=vocab, causal=causal, gqa=gqa)
+    h1 = t1.fit(x, y, epochs=2, batch_size=32)
+
+    m2 = _stock_model(seed=7, maxlen=maxlen, vocab=vocab, causal=causal,
+                      gqa=gqa)
+    t2 = SequenceShardedTrainer(
+        m2, sequence_parallel=2, data_parallel=2, attention=attention
+    )
+    h2 = t2.fit(x, y, epochs=2, batch_size=32)
+
+    np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=2e-3)
+    for a, b in zip(m1.get_weights(), m2.get_weights()):
+        np.testing.assert_allclose(a, b, atol=2e-3, rtol=2e-3)
+
+    e1 = t1.evaluate(x, y, batch_size=32)
+    e2 = t2.evaluate(x, y, batch_size=32)
+    for key in e1:
+        np.testing.assert_allclose(e1[key], e2[key], rtol=5e-3, err_msg=key)
+
+
+def test_stock_attention_tp_matches_unsharded():
+    """Megatron head-sharding of stock-MHA EinsumDense kernels: the
+    planner's new rules shard query/key/value ([D, N, H]) and
+    attention_output ([N, H, D]) over the model axis, to oracle parity."""
+    maxlen, vocab = 32, 64
+    x, y = _marker_task(128, maxlen, vocab, seed=5)
+
+    m1, t1 = _oracle(9, maxlen=maxlen, vocab=vocab)
+    h1 = t1.fit(x, y, epochs=2, batch_size=32)
+
+    m2 = _stock_model(seed=9, maxlen=maxlen, vocab=vocab)
+    t2 = ShardedTrainer(m2, model_parallel=2)
+    summary = t2.sharding_summary()
+    for sub in ("query", "key", "value", "attention_output"):
+        path = f"att/{sub}/kernel"
+        assert any(
+            path in p and "model" in spec for p, spec in summary.items()
+        ), (path, summary)
+    h2 = t2.fit(x, y, epochs=2, batch_size=32)
+
+    np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=2e-3)
+    for a, b in zip(m1.get_weights(), m2.get_weights()):
+        np.testing.assert_allclose(a, b, atol=2e-3, rtol=2e-3)
+
+
+def test_stock_model_no_silent_replication_warnings(caplog):
+    """The r3 gap made stock models warn ("sharded NOTHING" under TP,
+    no-FlashMHA under SP) and silently replicate; both warnings must be
+    gone now that the adapter and planner rules engage."""
+    model = _stock_model(seed=1)
+    with caplog.at_level(logging.WARNING, logger="elephas_tpu"):
+        SequenceShardedTrainer(model, sequence_parallel=2, data_parallel=2)
+        ShardedTrainer(_stock_model(seed=1), model_parallel=2)
+    assert not [r for r in caplog.records if "sharded NOTHING" in r.message]
+    assert not [
+        r for r in caplog.records if "no sequence-aware" in r.message
+    ]
+
+
+def test_patch_is_inert_outside_scope():
+    """A patched model stays an ordinary Keras model: predictions
+    outside any sequence scope equal the unpatched model's."""
+    m1 = _stock_model(seed=11)
+    m2 = _stock_model(seed=11)
+    n = patch_stock_attention(m2)
+    assert n == 1
+    x, _ = _marker_task(16, 32, 64, seed=2)
+    np.testing.assert_allclose(
+        m1.predict(x, verbose=0), m2.predict(x, verbose=0), atol=1e-6
+    )
+    # idempotent: re-patching finds the layer already patched
+    assert patch_stock_attention(m2) == 1
+
+
+def test_stock_causal_dropout_fallback_keeps_mask():
+    """code-review r4: a layer with attention dropout falls back to the
+    stock path under the sequence scope — but use_causal_mask was
+    already absorbed by the patched mask builder, so the fallback must
+    rebuild the causal mask or attention silently goes bidirectional.
+    Inference (dropout inert) under the scope must equal the unpatched
+    model exactly."""
+    m1 = _stock_model(seed=21, causal=True, dropout=0.3)
+    m2 = _stock_model(seed=21, causal=True, dropout=0.3)
+    t2 = SequenceShardedTrainer(m2, sequence_parallel=2, data_parallel=2)
+    x, _ = _marker_task(32, 32, 64, seed=6)
+    p1 = m1.predict(x, verbose=0)
+    p2 = t2.predict(x, batch_size=32)
+    np.testing.assert_allclose(p1, p2, atol=1e-5, rtol=1e-5)
+
+
+def test_spark_model_stock_sp_and_tp(spark_context):
+    """The L5 'Done =' check: a stock-Keras-only model trains through
+    SparkModel(sequence_parallel=2) and SparkModel(model_parallel=2)."""
+    from elephas_tpu import SparkModel
+
+    maxlen, vocab = 32, 64
+    x, y = _marker_task(256, maxlen, vocab, seed=4)
+
+    for kw in ({"sequence_parallel": 2}, {"model_parallel": 2}):
+        sm = SparkModel(_stock_model(seed=13), **kw)
+        history = sm.fit((x, y), epochs=3, batch_size=32)
+        assert np.isfinite(history["loss"]).all()
+        assert history["loss"][-1] < history["loss"][0], (kw, history)
