@@ -259,12 +259,21 @@ def flash_attention(
     v,
     causal: bool = False,
     scale: float | None = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Blockwise attention. ``q/k/v``: ``[batch, heads, seq, head_dim]``
-    (or ``[bh, seq, head_dim]``). Differentiable; O(seq) memory."""
+    (or ``[bh, seq, head_dim]``). Differentiable; O(seq) memory.
+
+    ``block_q``/``block_k`` default to the module-level
+    ``DEFAULT_BLOCK_Q``/``DEFAULT_BLOCK_K`` (resolved at CALL time, so
+    benchmarks can sweep tile sizes globally without threading
+    arguments through the model builders)."""
+    if block_q is None:
+        block_q = DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = DEFAULT_BLOCK_K
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
