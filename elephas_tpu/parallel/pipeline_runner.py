@@ -11,8 +11,11 @@ reference's fit-one-worker ceiling, SURVEY.md §2a).
 
 Scope (honest restrictions, enforced loudly):
 
-- Sequential-topology models (one input, one output, layers in a
-  chain) — the realistic PP case;
+- Single-input single-output models, Sequential OR functional (r4): the
+  graph is cut wherever exactly one live tensor crosses — a ResNet
+  residual block is one atomic segment (its skip keeps two tensors
+  live), so residual convnets pipeline; multi-input/output graphs
+  don't;
 - float non-trainable state (BatchNorm moving statistics) trains
   through the pipe (r4): it rides a stage-sharded flat buffer updated
   by the owning stage, per-microbatch — standard GPipe BN semantics —
@@ -262,52 +265,124 @@ def _optax_from_keras(optimizer):
     )
 
 
-def _chain_layers(model) -> list:
-    """The model's layers as a single chain, or raise.
+def _graph_nodes(model):
+    """Topologically ordered operation nodes of the model's functional
+    graph (``keras.Sequential`` included via its underlying Functional),
+    plus the single input / single output KerasTensors.
 
-    Only ``keras.Sequential`` guarantees that applying ``model.layers``
-    in order IS the model — a functional graph with skip connections
-    (residual Adds) has 1 input / 1 output too, and composing its layer
-    list sequentially would silently compute a different function."""
+    r4: this replaces the Sequential-only layer chain — residual/branchy
+    single-in single-out graphs (ResNet!) pipeline too, cut wherever the
+    live-tensor width is one (see :func:`_segment_graph`)."""
     import keras
 
-    if not isinstance(model, keras.Sequential):
+    fun = model
+    if isinstance(model, keras.Sequential):
+        fun = getattr(model, "_functional", None) or model
+    if (
+        not hasattr(fun, "_nodes_by_depth")
+        or len(getattr(fun, "inputs", []) or []) != 1
+        or len(getattr(fun, "outputs", []) or []) != 1
+    ):
         raise ValueError(
-            "pipeline_parallel requires a keras.Sequential model (layer-"
-            "list order must BE the computation; functional graphs with "
-            "branches/residuals would silently mis-compose) — use "
-            "model_parallel for non-chain architectures"
+            "pipeline_parallel requires a single-input single-output "
+            "functional (or Sequential) model — use model_parallel for "
+            "multi-input/multi-output architectures"
         )
-    layers = [l for l in model.layers if type(l).__name__ != "InputLayer"]
-    if not layers:
-        raise ValueError("model has no layers to pipeline")
-    return layers
+    nodes = []
+    for depth in sorted(fun._nodes_by_depth, reverse=True):
+        for node in fun._nodes_by_depth[depth]:
+            if node.is_input:
+                continue
+            nodes.append(node)
+    if not nodes:
+        raise ValueError("model has no operations to pipeline")
+    return nodes, fun.inputs[0], fun.outputs[0]
 
 
-def _partition_balanced(layers: list, num_stages: int) -> list[list]:
-    """Contiguous layer groups, greedily balanced by parameter count."""
-    weights = [
-        max(1, sum(int(np.prod(v.shape)) for v in l.trainable_variables))
-        for l in layers
-    ]
-    if len(layers) < num_stages:
+def _segment_graph(nodes, input_kt, output_kt):
+    """Split the node list at single-tensor cut points.
+
+    A cut after node ``p`` is valid when exactly ONE tensor produced at
+    or before ``p`` (the model input counts as produced before node 0)
+    is still needed after ``p`` (the model output counts as consumed at
+    the very end). Between consecutive cuts lies a *segment* — the
+    pipeline's atomic unit, with one input tensor and one output tensor
+    (a ResNet residual block is one segment: the skip keeps two tensors
+    live inside it, so no cut lands mid-block).
+
+    Returns ``[(node_sublist, in_kt, out_kt), ...]``.
+    """
+    kt_by_id = {id(input_kt): input_kt}
+    for node in nodes:
+        for kt in node.outputs:
+            kt_by_id[id(kt)] = kt
+    last_use: dict[int, int] = {}
+    for i, node in enumerate(nodes):
+        for kt in node.input_tensors:
+            last_use[id(kt)] = max(last_use.get(id(kt), -1), i)
+    last_use[id(output_kt)] = len(nodes)
+
+    # one forward pass with a running live set: add a node's outputs,
+    # retire tensors whose last use is the current node — O(N + T),
+    # not a full liveness rescan per candidate cut (code-review r4)
+    cuts = []
+    live = {id(input_kt)} if last_use.get(id(input_kt), -1) >= 0 else set()
+    for p, node in enumerate(nodes[:-1]):
+        for kt in node.outputs:
+            if last_use.get(id(kt), -1) > p or id(kt) == id(output_kt):
+                live.add(id(kt))
+        for kt in list(live):
+            if last_use.get(kt, -1) <= p:
+                live.discard(kt)
+        if len(live) == 1:
+            cuts.append((p, kt_by_id[next(iter(live))]))
+
+    segments = []
+    start, seg_in = 0, input_kt
+    for p, kt in cuts:
+        segments.append((nodes[start : p + 1], seg_in, kt))
+        start, seg_in = p + 1, kt
+    segments.append((nodes[start:], seg_in, output_kt))
+    return segments
+
+
+def _node_layers(nodes) -> list:
+    """Unique Layer operations among ``nodes``, in first-use order."""
+    import keras
+
+    seen, out = set(), []
+    for node in nodes:
+        op = node.operation
+        if isinstance(op, keras.Layer) and id(op) not in seen:
+            seen.add(id(op))
+            out.append(op)
+    return out
+
+
+def _partition_balanced(items: list, num_stages: int, weight_fn) -> list[list]:
+    """Contiguous groups of ``items``, greedily balanced by
+    ``weight_fn(item)`` (parameter counts)."""
+    weights = [max(1, int(weight_fn(it))) for it in items]
+    if len(items) < num_stages:
         raise ValueError(
-            f"{len(layers)} layers cannot split into {num_stages} stages"
+            f"{len(items)} pipeline segments cannot split into "
+            f"{num_stages} stages — the graph's single-tensor cut "
+            f"points bound the stage count"
         )
     total = sum(weights)
     target = total / num_stages
     groups, cur, acc = [], [], 0.0
     remaining = num_stages
-    for i, (layer, w) in enumerate(zip(layers, weights)):
-        cur.append(layer)
+    for i, (item, w) in enumerate(zip(items, weights)):
+        cur.append(item)
         acc += w
-        layers_left = len(layers) - i - 1
+        items_left = len(items) - i - 1
         # close when the group reaches the running target (keeping one
-        # layer per remaining stage) — or when exactly enough layers
+        # item per remaining stage) — or when exactly enough items
         # remain for the remaining stages (feasibility forces a close
         # even under-target)
-        reached = acc >= target and layers_left >= remaining - 1
-        must = layers_left == remaining - 1
+        reached = acc >= target and items_left >= remaining - 1
+        must = items_left == remaining - 1
         if remaining > 1 and (reached or must):
             groups.append(cur)
             cur, acc = [], 0.0
@@ -333,7 +408,8 @@ class PipelineRunner:
         self.model = model
         self.num_stages = num_stages
         self.num_workers = max(1, int(data_parallel))  # data replicas
-        layers = _chain_layers(model)
+        nodes, input_kt, output_kt = _graph_nodes(model)
+        layers = _node_layers(nodes)
         _REG_ATTRS = (
             "kernel_regularizer", "bias_regularizer",
             "activity_regularizer", "beta_regularizer",
@@ -404,46 +480,117 @@ class PipelineRunner:
                 "and evaluate from the reported loss) — remove them or "
                 "use model_parallel"
             )
-        self._stage_layers = _partition_balanced(layers, num_stages)
+        segments = _segment_graph(nodes, input_kt, output_kt)
 
-        def make_stage_fn(group):
-            def stage_fn(params, state, x, training):
-                h = x
-                new_state = {}
-                for i, layer in enumerate(group):
-                    tv = params[f"l{i}"]
-                    ntv = state[f"l{i}"]
-                    # stateless_call forwards kwargs straight to call();
-                    # only layers whose call() takes `training` (BN,
-                    # Dense) may receive it — Conv2D's does not
-                    kw = (
-                        {"training": training}
-                        if layer._call_has_training_arg
-                        else {}
+        # a layer reused across segments (weight tying) contributes its
+        # parameters ONCE, to the first segment that uses it — double
+        # counting would skew the balanced split (code-review r4)
+        _counted: set[int] = set()
+
+        def _segment_weight(seg):
+            seg_nodes, _in, _out = seg
+            total = 0
+            for l in _node_layers(seg_nodes):
+                if id(l) in _counted:
+                    continue
+                _counted.add(id(l))
+                total += sum(
+                    int(np.prod(v.shape)) for v in l.trainable_variables
+                )
+            return total
+
+        groups = _partition_balanced(segments, num_stages, _segment_weight)
+        # per stage: concatenated node program + its boundary tensors
+        self._stage_programs = [
+            (
+                [n for seg in g for n in seg[0]],  # nodes
+                g[0][1],  # input tensor of the first segment
+                g[-1][2],  # output tensor of the last segment
+            )
+            for g in groups
+        ]
+        self._stage_layers = [
+            _node_layers(prog[0]) for prog in self._stage_programs
+        ]
+        # weight tying ACROSS the stage split would give each stage an
+        # independent, divergently-trained copy (keras sums gradients
+        # over all uses of a tied weight; stages only see their local
+        # gradient) — reject instead of training silently wrong
+        # (code-review r4). Reuse WITHIN one stage is fine: the stage
+        # program chains its state and the gradient sums naturally.
+        owner: dict[int, int] = {}
+        for si, group_layers in enumerate(self._stage_layers):
+            for l in group_layers:
+                if id(l) in owner and owner[id(l)] != si:
+                    raise ValueError(
+                        f"pipeline_parallel: layer {l.name!r} is reused "
+                        f"at graph nodes that fall in stages "
+                        f"{owner[id(l)]} and {si} (weight tying across "
+                        f"the pipeline split) — each stage would train "
+                        f"an independent copy of its weights; use "
+                        f"model_parallel for weight-tied models"
                     )
-                    h, ntv2 = layer.stateless_call(tv, ntv, h, **kw)
-                    new_state[f"l{i}"] = list(ntv2)
-                return h, new_state
+                owner[id(l)] = si
+
+        import keras
+        from keras import tree as ktree
+
+        def make_stage_fn(prog):
+            prog_nodes, in_kt, out_kt = prog
+
+            def stage_fn(params, state, x, training):
+                tensors = {id(in_kt): x}
+                new_state = dict(state)
+                for node in prog_nodes:
+                    args, kwargs = node.arguments.fill_in(tensors)
+                    op = node.operation
+                    if isinstance(op, keras.Layer):
+                        # stateless_call forwards kwargs straight to
+                        # call(); only layers whose call() takes
+                        # `training` (BN, Dense) may receive it —
+                        # Conv2D's does not
+                        if op._call_has_training_arg:
+                            kwargs["training"] = training
+                        else:
+                            kwargs.pop("training", None)
+                        tv = params.get(op.name, [])
+                        # a layer reused at several nodes (weight tying)
+                        # chains its state through new_state
+                        ntv = new_state.get(op.name, [])
+                        out, ntv2 = op.stateless_call(
+                            tv, ntv, *args, **kwargs
+                        )
+                        if op.name in new_state:
+                            new_state[op.name] = list(ntv2)
+                    else:  # weightless keras Operation (e.g. `h + x`)
+                        out = op(*args, **kwargs)
+                    for kt, val in zip(node.outputs, ktree.flatten(out)):
+                        tensors[id(kt)] = val
+                return tensors[id(out_kt)], new_state
 
             return stage_fn
 
-        stage_fns = [make_stage_fn(g) for g in self._stage_layers]
+        stage_fns = [make_stage_fn(p) for p in self._stage_programs]
         stage_params = [
             {
-                f"l{i}": [jnp.asarray(v.value) for v in layer.trainable_variables]
-                for i, layer in enumerate(group)
+                layer.name: [
+                    jnp.asarray(v.value) for v in layer.trainable_variables
+                ]
+                for layer in group_layers
+                if layer.trainable_variables
             }
-            for group in self._stage_layers
+            for group_layers in self._stage_layers
         ]
         stage_states = [
             {
-                f"l{i}": [
+                layer.name: [
                     jnp.asarray(v.value)
                     for v in layer.non_trainable_variables
                 ]
-                for i, layer in enumerate(group)
+                for layer in group_layers
+                if layer.non_trainable_variables
             }
-            for group in self._stage_layers
+            for group_layers in self._stage_layers
         ]
 
         # per-sample loss from the compile config → microbatch mean
@@ -477,11 +624,14 @@ class PipelineRunner:
         for group, params, states in zip(
             self._stage_layers, all_params, all_states
         ):
-            for i, layer in enumerate(group):
-                for var, val in zip(layer.trainable_variables, params[f"l{i}"]):
+            for layer in group:
+                for var, val in zip(
+                    layer.trainable_variables, params.get(layer.name, [])
+                ):
                     var.assign(np.asarray(val))
                 for var, val in zip(
-                    layer.non_trainable_variables, states[f"l{i}"]
+                    layer.non_trainable_variables,
+                    states.get(layer.name, []),
                 ):
                     var.assign(np.asarray(val))
 
