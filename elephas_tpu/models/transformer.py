@@ -56,8 +56,6 @@ def _flash_mha_layer():
         return _FLASH_MHA_CLS
     import keras
 
-    from elephas_tpu.ops import flash_attention
-
     @keras.saving.register_keras_serializable(package="elephas_tpu")
     class FlashMHA(keras.layers.Layer):
         """Multi-head self-attention over the Pallas flash kernel.
@@ -93,22 +91,34 @@ def _flash_mha_layer():
                 active_sequence_scope, ring_mha,
             )
 
+            from elephas_tpu.ops.flash_attention import flash_attention_qkv
+
             B = jnp.shape(x)[0]
             S = x.shape[1]
             H, D = self.num_heads, self.head_dim
             qkv = self.qkv(x)  # [B, S, 3*H*D]
             qkv = jnp.reshape(qkv, (B, S, 3, H, D))
-            qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3, B, H, S, D]
-            q, k, v = qkv[0], qkv[1], qkv[2]
             scope = active_sequence_scope()
             if scope is not None:
                 # sequence-parallel region: the S axis is sharded over
                 # the mesh — ring the KV shards instead of running the
                 # single-chip flash kernel on a gathered sequence
-                out = ring_mha(q, k, v, causal=self.causal, scope=scope)
+                qkv_t = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3,B,H,S,D]
+                out = ring_mha(
+                    qkv_t[0], qkv_t[1], qkv_t[2], causal=self.causal,
+                    scope=scope,
+                )
+                out = jnp.reshape(
+                    jnp.transpose(out, (0, 2, 1, 3)), (B, S, H * D)
+                )
             else:
-                out = flash_attention(q, k, v, causal=self.causal)
-            out = jnp.reshape(jnp.transpose(out, (0, 2, 1, 3)), (B, S, H * D))
+                # packed-layout kernel (r4): q/k/v are read straight
+                # from the fused projection and the output lands
+                # sequence-major — the bhsd transposes (the top copy
+                # kernels in the r4 transformer trace, fwd AND their
+                # bwd counterparts) never materialize
+                out = flash_attention_qkv(qkv, causal=self.causal)
+                out = jnp.reshape(out, (B, S, H * D))
             return self.proj(out)
 
         def get_config(self):

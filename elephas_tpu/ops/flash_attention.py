@@ -40,6 +40,9 @@ NEG_INF = -1e30
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                 *, scale: float, causal: bool, block_q: int, block_k: int):
+    # refs arrive squeezed to [BQ, D] / [BK, D] / [BQ, D] / [1, BQ]
+    # (BlockSpec ``None`` dims), so one kernel serves both the separate
+    # [BH, S, D] layout and the packed [B, S, 3, H, D] qkv layout
     j = pl.program_id(2)
     last_j = pl.num_programs(2) - 1
 
@@ -49,8 +52,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]  # [BQ, D]
-    k = k_ref[0]  # [BK, D]
+    q = q_ref[:]  # [BQ, D]
+    k = k_ref[:]  # [BK, D]
     s = jax.lax.dot_general(
         q, k,
         dimension_numbers=(((1,), (1,)), ((), ())),
@@ -77,7 +80,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     alpha = jnp.exp(m_prev - m_new)  # [BQ, 1]
     l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p, v_ref[0].astype(jnp.float32),
+        p, v_ref[:].astype(jnp.float32),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
@@ -89,14 +92,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         # fully-masked rows kept l == 0 via the p guard above; they output
         # zeros with lse == NEG_INF (zero weight in ring-attention merges)
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0, :] = (m_ref[:] + jnp.log(safe_l))[:, 0]
+        o_ref[:] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, :] = (m_ref[:] + jnp.log(safe_l))[:, 0]
 
 
-def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
-    """[BH, S, D] inputs → (out [BH, S, D], lse [BH, S])."""
-    bh, s_q, d = q.shape
-    s_k = k.shape[1]
+def _resolve_blocks(block_q, block_k, s_q, s_k):
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
     if s_q % block_q or s_k % block_k:
@@ -104,6 +104,26 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
             f"sequence lengths ({s_q}, {s_k}) must be multiples of the "
             f"block sizes ({block_q}, {block_k})"
         )
+    return block_q, block_k
+
+
+def _cost(bh, s_q, s_k, d, itemsize):
+    # keras symbolic builds trace with a polymorphic batch dim
+    # (_DimExpr); CostEstimate requires concrete ints
+    if not all(type(t) is int for t in (bh, s_q, s_k, d)):
+        return None
+    return pl.CostEstimate(
+        flops=4 * bh * s_q * s_k * d,
+        bytes_accessed=(2 * bh * s_q * d + 2 * bh * s_k * d) * itemsize,
+        transcendentals=bh * s_q * s_k,
+    )
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
+    """[BH, S, D] inputs → (out [BH, S, D], lse [BH, S])."""
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    block_q, block_k = _resolve_blocks(block_q, block_k, s_q, s_k)
     grid = (bh, s_q // block_q, s_k // block_k)
     kernel = functools.partial(
         _fwd_kernel,
@@ -116,15 +136,15 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
             # lse rides as [BH, 1, S] so the trailing block dims (1, block_q)
             # meet Mosaic's (equal-dim, 128-divisible) tiling rule
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
@@ -135,17 +155,71 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
-        # keras symbolic builds trace with a polymorphic batch dim
-        # (_DimExpr); CostEstimate requires concrete ints
-        cost_estimate=pl.CostEstimate(
-            flops=4 * bh * s_q * s_k * d,
-            bytes_accessed=(2 * bh * s_q * d + 2 * bh * s_k * d) * q.dtype.itemsize,
-            transcendentals=bh * s_q * s_k,
-        )
-        if all(type(t) is int for t in (bh, s_q, s_k, d))
-        else None,
+        cost_estimate=_cost(bh, s_q, s_k, d, q.dtype.itemsize),
         interpret=interpret,
     )(q, k, v)
+    return out, lse[:, 0, :]
+
+
+def _flash_forward_packed(qkv, h, d, scale, causal, block_q, block_k,
+                          interpret):
+    """Packed qkv → (out ``[B, S, H·D]``, lse ``[B·H, S]``).
+
+    ``qkv``: ``[B, S, 3·H·D]`` — the fused projection's output, as
+    produced. The kernel reads q/k/v via three index maps over the ONE
+    flat array (head ``h`` of q/k/v lives at last-dim block index
+    ``h`` / ``H+h`` / ``2H+h`` in D-sized blocks), so the
+    [B,S,H,D]→[B,H,S,D] transposes — the top copy kernels in the r4
+    trace — never materialize, and the output lands sequence-major
+    ready for the out-projection. Mosaic's tiling rule makes this
+    layout legal only when ``D % 128 == 0`` (the last BLOCK dim must be
+    128-divisible or span the array dim); callers gate on that."""
+    b, s, fused = qkv.shape
+    assert fused == 3 * h * d, (qkv.shape, h, d)
+    block_q, block_k = _resolve_blocks(block_q, block_k, s, s)
+    grid = (b * h, s // block_q, s // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (None, block_q, d), lambda bh, i, j, h=h: (bh // h, i, bh % h)
+            ),
+            pl.BlockSpec(
+                (None, block_k, d),
+                lambda bh, i, j, h=h: (bh // h, j, h + bh % h),
+            ),
+            pl.BlockSpec(
+                (None, block_k, d),
+                lambda bh, i, j, h=h: (bh // h, j, 2 * h + bh % h),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (None, block_q, d), lambda bh, i, j, h=h: (bh // h, i, bh % h)
+            ),
+            pl.BlockSpec((None, 1, block_q), lambda bh, i, j: (bh, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        cost_estimate=_cost(b * h, s, s, d, qkv.dtype.itemsize),
+        interpret=interpret,
+    )(qkv, qkv, qkv)
     return out, lse[:, 0, :]
 
 
@@ -232,6 +306,26 @@ def _flash_backward(scale, causal, block_q, block_k, residuals, g):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _flash_backward_packed(scale, causal, block_q, block_k, residuals, g):
+    """Flash backward for the packed layout: the head-free
+    :func:`_flash_backward` vmapped over the head axis of the
+    ``[B, S, H, D]`` views — identical recurrences (one copy of the
+    numerically delicate math), batched einsums, no bhsd transposes
+    materialized. Returns ``(d(qkv) [B, S, 3, H, D],)``."""
+    qkv, out, lse = residuals
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, S, H, D]
+
+    def per_head(q_, k_, v_, o_, l_, g_):
+        return _flash_backward(
+            scale, causal, block_q, block_k, (q_, k_, v_, o_, l_), g_
+        )
+
+    dq, dk, dv = jax.vmap(
+        per_head, in_axes=(2, 2, 2, 2, 1, 2), out_axes=2
+    )(q, k, v, out, lse, g)
+    return (jnp.stack([dq, dk, dv], axis=2),)
+
+
 # -- public op ---------------------------------------------------------
 
 
@@ -251,6 +345,89 @@ def _bwd_rule(scale, causal, block_q, block_k, interpret, residuals, g):
 
 
 _flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _flash_attention_packed(qkv, scale, causal, block_q, block_k, interpret):
+    b, s, _, h, d = qkv.shape
+    out, _ = _flash_forward_packed(
+        qkv.reshape(b, s, 3 * h * d), h, d, scale, causal, block_q,
+        block_k, interpret,
+    )
+    return out.reshape(b, s, h, d)
+
+
+def _fwd_rule_packed(qkv, scale, causal, block_q, block_k, interpret):
+    b, s, _, h, d = qkv.shape
+    out, lse = _flash_forward_packed(
+        qkv.reshape(b, s, 3 * h * d), h, d, scale, causal, block_q,
+        block_k, interpret,
+    )
+    out = out.reshape(b, s, h, d)
+    return out, (qkv, out, lse.reshape(b, h, s))
+
+
+def _bwd_rule_packed(scale, causal, block_q, block_k, interpret, residuals, g):
+    return _flash_backward_packed(
+        scale, causal, block_q, block_k, residuals, g
+    )
+
+
+_flash_attention_packed.defvjp(_fwd_rule_packed, _bwd_rule_packed)
+
+
+def flash_attention_qkv(
+    qkv,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Self-attention straight from a fused qkv projection.
+
+    ``qkv``: ``[B, S, 3, H, D]`` — the packed output of one
+    ``Dense(3·H·D)`` reshaped, exactly as produced. Returns
+    ``[B, S, H, D]``. Numerically identical to
+    ``flash_attention(q, k, v)`` on the unpacked slices, but the kernel
+    reads q/k/v via three index maps over the ONE packed array and
+    writes output in the sequence-major layout the next projection
+    consumes — the [B,S,·,H,D]→[·,B,H,S,D] transpose copies (the
+    largest copy kernels in the r4 transformer trace, fwd and bwd)
+    never exist. Differentiable (custom VJP in the same layout)."""
+    if block_q is None:
+        block_q = DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = DEFAULT_BLOCK_K
+    if scale is None:
+        scale = qkv.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    b, s, _, h, d = qkv.shape
+    if int(d) % 128 and not interpret:
+        # Mosaic's tiling rule rejects D-sized last-dim blocks unless
+        # D % 128 == 0 — small head dims take the transposed layout
+        # (same math, with the copy cost the packed path avoids)
+        qkv_t = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3, B, H, S, D]
+        out = _flash_attention_bhsd(
+            qkv_t[0].reshape(b * h, s, d),
+            qkv_t[1].reshape(b * h, s, d),
+            qkv_t[2].reshape(b * h, s, d),
+            float(scale),
+            bool(causal),
+            int(block_q),
+            int(block_k),
+            bool(interpret),
+        )
+        return jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
+    return _flash_attention_packed(
+        qkv,
+        float(scale),
+        bool(causal),
+        int(block_q),
+        int(block_k),
+        bool(interpret),
+    )
 
 
 def flash_attention(
