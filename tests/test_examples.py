@@ -46,6 +46,13 @@ def test_pipeline_parallel_mlp():
     )
 
 
+def test_resnet_pipeline_parallel():
+    run_example(
+        "resnet_pipeline_parallel",
+        ["--epochs", "2", "--stages", "2", "--batch-size", "32"],
+    )
+
+
 def test_long_context_ring():
     run_example(
         "long_context_ring",
