@@ -370,10 +370,12 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k, seed):
     (``Function._run_through_graph``), each node's operation swapped for
     a single-token decode handler:
 
-    - ``FlashMHA`` becomes a cached-attention read/write — per-layer
-      ``[B, S, H, Dh]`` K/V caches keyed by layer name, one token's
-      q/k/v computed and attention taken over the cache (O(S·L) for the
-      whole generation vs the default path's O(S²·L));
+    - ``FlashMHA`` — and stock ``keras.layers.MultiHeadAttention``
+      called self-attentively with ``use_causal_mask=True`` (r4) —
+      become cached-attention read/writes: per-layer ``[B, S, H, Dh]``
+      K/V caches keyed by layer name, one token's q/k/v computed and
+      attention taken over the cache (O(S·L) for the whole generation
+      vs the default path's O(S²·L));
     - layers with weights run ``stateless_call`` on the ``[B, D]`` token
       activations, weights riding as jit ARGUMENTS so further training
       never serves stale baked-in constants;
@@ -405,10 +407,48 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k, seed):
     flash_layers = [
         l for l in model._flatten_layers() if isinstance(l, FlashMHA)
     ]
-    if not flash_layers:
+    gqa_cls = getattr(
+        keras.layers, "GroupQueryAttention", None
+    ) or getattr(keras.layers, "GroupedQueryAttention", None)
+
+    def _stock_layers_of(base):
+        if base is None:
+            return []
+        found = []
+        for l in model._flatten_layers():
+            if not isinstance(l, base):
+                continue
+            # the decode handler recomputes STOCK attention math from
+            # the EinsumDense kernels; a subclass overriding call /
+            # _compute_attention (RoPE, ALiBi, soft-caps...) would
+            # silently decode different tokens — reject with guidance
+            # (code-review r4)
+            if (
+                type(l).call is not base.call
+                or type(l)._compute_attention is not base._compute_attention
+            ):
+                raise ValueError(
+                    f"kv_cache decode replays stock {base.__name__} math, "
+                    f"but {l.name!r} is a customized subclass "
+                    f"({type(l).__name__}); use kv_cache=False"
+                )
+            if len(l._output_dense.kernel.shape) != 3:
+                raise ValueError(
+                    f"kv_cache decode: {l.name!r} has a non-default "
+                    f"output_shape (rank-"
+                    f"{len(l._output_dense.kernel.shape)} output "
+                    f"kernel); use kv_cache=False"
+                )
+            found.append(l)
+        return found
+
+    stock_mha_layers = _stock_layers_of(keras.layers.MultiHeadAttention)
+    gqa_layers = _stock_layers_of(gqa_cls)
+    if not flash_layers and not stock_mha_layers and not gqa_layers:
         raise ValueError(
-            "kv_cache=True needs at least one FlashMHA attention layer "
-            "(the cache lives there); use kv_cache=False"
+            "kv_cache=True needs at least one attention layer (FlashMHA, "
+            "keras MultiHeadAttention, or GroupQueryAttention — the "
+            "cache lives there); use kv_cache=False"
         )
     for l in flash_layers:
         if not l.causal:
@@ -416,6 +456,7 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k, seed):
                 f"kv_cache decode is causal by construction, but FlashMHA "
                 f"layer {l.name!r} has causal=False; use kv_cache=False"
             )
+    for l in flash_layers + stock_mha_layers + gqa_layers:
         if len(l._inbound_nodes) > 1:
             # weight-tied reuse (ALBERT-style): every call site would
             # share ONE name-keyed cache and clobber the others' K/V
@@ -510,6 +551,86 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k, seed):
                         )
 
                     return attn
+                if isinstance(op, keras.layers.MultiHeadAttention) or (
+                    gqa_cls is not None and isinstance(op, gqa_cls)
+                ):
+                    def attn_stock(query, *pos, _op=op, **kwargs):
+                        if not kwargs.get("use_causal_mask"):
+                            raise ValueError(
+                                f"kv_cache decode: stock attention layer "
+                                f"{_op.name!r} is called without "
+                                f"use_causal_mask=True — non-causal "
+                                f"attention cannot decode token-by-"
+                                f"token; use kv_cache=False"
+                            )
+                        value = pos[0] if pos else kwargs.get("value")
+                        key_in = (
+                            pos[1] if len(pos) > 1 else kwargs.get("key")
+                        )
+                        if value is not query or (
+                            key_in is not None and key_in is not query
+                        ):
+                            raise ValueError(
+                                f"kv_cache decode: {_op.name!r} is used "
+                                f"as cross-attention; use kv_cache=False"
+                            )
+                        for bad in ("attention_mask", "query_mask",
+                                    "value_mask", "key_mask"):
+                            if kwargs.get(bad) is not None:
+                                raise ValueError(
+                                    f"kv_cache decode: {_op.name!r} "
+                                    f"carries an explicit {bad}; use "
+                                    f"kv_cache=False"
+                                )
+                        if kwargs.get("return_attention_scores"):
+                            raise ValueError(
+                                f"kv_cache decode: {_op.name!r} returns "
+                                f"attention scores; use kv_cache=False"
+                            )
+
+                        def dense(sub, x_, eq_in, eq_out):
+                            y = jnp.einsum(
+                                f"{eq_in}->{eq_out}", x_,
+                                w[sub.kernel.path],
+                            )
+                            if sub.bias is not None:
+                                y = y + w[sub.bias.path]
+                            return y
+
+                        x = query  # [B, D]
+                        q = dense(_op._query_dense, x, "bd,dhk", "bhk")
+                        k = dense(_op._key_dense, x, "bd,dhk", "bhk")
+                        v = dense(_op._value_dense, x, "bd,dhv", "bhv")
+                        ck, cv = caches[_op.name]
+                        ck = ck.at[:, t].set(k)
+                        cv = cv.at[:, t].set(v)
+                        inv = getattr(_op, "_inverse_sqrt_key_dim", None)
+                        if inv is None:  # GQA names it by head_dim
+                            inv = _op._inverse_sqrt_head_dim
+                        # one grouped attend covers both: the cache holds
+                        # UN-repeated kv heads and query heads attend in
+                        # groups of rep (rep == 1 for plain MHA)
+                        hq, hkv = q.shape[1], k.shape[1]
+                        rep = hq // hkv
+                        qg = q.reshape(q.shape[0], hkv, rep, q.shape[-1])
+                        att = jnp.einsum(
+                            "bgrk,bsgk->bgrs", qg, ck
+                        ) * float(inv)
+                        visible = (
+                            jnp.arange(maxlen)[None, None, None, :] <= t
+                        )
+                        att = jax.nn.softmax(
+                            jnp.where(visible, att, -jnp.inf), axis=-1
+                        )
+                        ctx = jnp.einsum(
+                            "bgrs,bsgv->bgrv", att, cv
+                        ).reshape(q.shape[0], hq, cv.shape[-1])
+                        ctx_new[_op.name] = (ck, cv)
+                        return dense(
+                            _op._output_dense, ctx, "bhv,hvd", "bd"
+                        )
+
+                    return attn_stock
                 if isinstance(op, keras.layers.Dropout):
                     return lambda x, *a, **k: x
                 if isinstance(op, keras.Layer) and op.variables:
@@ -552,6 +673,28 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k, seed):
                 )
                 for l in flash_layers
             }
+            for l in stock_mha_layers:
+                caches[l.name] = (
+                    jnp.zeros(
+                        (b, maxlen, l._num_heads, l._key_dim), jnp.float32
+                    ),
+                    jnp.zeros(
+                        (b, maxlen, l._num_heads,
+                         l._value_dim or l._key_dim),
+                        jnp.float32,
+                    ),
+                )
+            for l in gqa_layers:
+                caches[l.name] = (
+                    jnp.zeros(
+                        (b, maxlen, l.num_key_value_heads, l.head_dim),
+                        jnp.float32,
+                    ),
+                    jnp.zeros(
+                        (b, maxlen, l.num_key_value_heads, l.head_dim),
+                        jnp.float32,
+                    ),
+                )
 
             def step(t, carry):
                 tokens, caches, key = carry

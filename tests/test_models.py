@@ -292,3 +292,132 @@ def test_generate_kv_cache_custom_causal_model():
                        from_logits=True))
     with pytest.raises(ValueError, match="weight tying"):
         generate(albert, prompt, steps=2, kv_cache=True)
+
+
+def test_generate_kv_cache_stock_keras_mha():
+    """r4: KV-cache decode handles STOCK keras MultiHeadAttention causal
+    LMs — the graph replay computes q/k/v from the EinsumDense kernels
+    for one token, attends over the cache, and reproduces the
+    full-recompute path exactly (greedy and sampled)."""
+    import keras
+    import pytest
+
+    from elephas_tpu.models import generate
+    from elephas_tpu.models.transformer import _positions
+
+    maxlen, vocab, d = 12, 8, 16
+    keras.utils.set_random_seed(5)
+    inp = keras.Input((maxlen,), dtype="int32")
+    h = keras.layers.Embedding(vocab, d, name="emb")(inp)
+    h = h + _positions(maxlen, d)[None]
+    a = keras.layers.MultiHeadAttention(
+        num_heads=2, key_dim=8, name="mha"
+    )(h, h, use_causal_mask=True)
+    h = keras.layers.LayerNormalization(name="ln")(h + a)
+    m_ = keras.layers.Dense(2 * d, activation="relu", name="up")(h)
+    h = h + keras.layers.Dense(d, name="down")(m_)
+    out = keras.layers.Dense(vocab, name="head_lm")(h)
+    model = keras.Model(inp, out)
+    model.compile(
+        optimizer=keras.optimizers.Adam(1e-2),
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+    )
+
+    rng = np.random.default_rng(1)
+    starts = rng.integers(2, 6, size=128)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+    model.fit(x, y, epochs=6, batch_size=32, verbose=0)
+
+    prompt = np.array([[2, 3, 4], [4, 5, 2]], np.int32)
+    full = generate(model, prompt, steps=6)
+    cached = generate(model, prompt, steps=6, kv_cache=True)
+    np.testing.assert_array_equal(cached, full)
+    s_full = generate(model, prompt, steps=6, temperature=0.7, top_k=3,
+                      seed=3)
+    s_cached = generate(model, prompt, steps=6, temperature=0.7, top_k=3,
+                        seed=3, kv_cache=True)
+    np.testing.assert_array_equal(s_cached, s_full)
+
+    # without use_causal_mask the layer is bidirectional — rejected
+    keras.utils.set_random_seed(6)
+    inp2 = keras.Input((maxlen,), dtype="int32")
+    h2 = keras.layers.Embedding(vocab, d)(inp2)
+    h2 = keras.layers.MultiHeadAttention(num_heads=2, key_dim=8)(h2, h2)
+    out2 = keras.layers.Dense(vocab)(h2)
+    bidir = keras.Model(inp2, out2)
+    bidir.compile(optimizer="adam",
+                  loss=keras.losses.SparseCategoricalCrossentropy(
+                      from_logits=True))
+    with pytest.raises(ValueError, match="use_causal_mask"):
+        generate(bidir, prompt, steps=2, kv_cache=True)
+
+
+def test_generate_kv_cache_stock_gqa():
+    """r4: GroupQueryAttention causal LMs decode cached — the K/V cache
+    holds UN-repeated kv heads and query heads attend in groups, with
+    outputs equal to the full-recompute path."""
+    import keras
+
+    from elephas_tpu.models import generate
+    from elephas_tpu.models.transformer import _positions
+
+    maxlen, vocab, d = 12, 8, 16
+    keras.utils.set_random_seed(8)
+    inp = keras.Input((maxlen,), dtype="int32")
+    h = keras.layers.Embedding(vocab, d, name="emb")(inp)
+    h = h + _positions(maxlen, d)[None]
+    a = keras.layers.GroupQueryAttention(
+        head_dim=8, num_query_heads=4, num_key_value_heads=2, name="gqa"
+    )(h, h, use_causal_mask=True)
+    h = keras.layers.LayerNormalization(name="ln")(h + a)
+    out = keras.layers.Dense(vocab, name="head_lm")(h)
+    model = keras.Model(inp, out)
+    model.compile(
+        optimizer=keras.optimizers.Adam(1e-2),
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+    )
+
+    rng = np.random.default_rng(2)
+    starts = rng.integers(2, 6, size=128)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+    model.fit(x, y, epochs=6, batch_size=32, verbose=0)
+
+    prompt = np.array([[2, 3, 4], [5, 2, 3]], np.int32)
+    full = generate(model, prompt, steps=6)
+    cached = generate(model, prompt, steps=6, kv_cache=True)
+    np.testing.assert_array_equal(cached, full)
+    s_full = generate(model, prompt, steps=6, temperature=0.6, top_k=3,
+                      seed=4)
+    s_cached = generate(model, prompt, steps=6, temperature=0.6, top_k=3,
+                        seed=4, kv_cache=True)
+    np.testing.assert_array_equal(s_cached, s_full)
+
+
+def test_generate_kv_cache_rejects_customized_attention_subclass():
+    """code-review r4: a MultiHeadAttention subclass overriding the
+    attention math (RoPE/ALiBi-style) must be rejected — the decode
+    handler would silently replay stock math instead."""
+    import keras
+    import pytest
+
+    from elephas_tpu.models import generate
+
+    class RotaryMHA(keras.layers.MultiHeadAttention):
+        def _compute_attention(self, *args, **kwargs):
+            return super()._compute_attention(*args, **kwargs)
+
+    maxlen, vocab, d = 8, 8, 16
+    keras.utils.set_random_seed(9)
+    inp = keras.Input((maxlen,), dtype="int32")
+    h = keras.layers.Embedding(vocab, d)(inp)
+    h = RotaryMHA(num_heads=2, key_dim=8)(h, h, use_causal_mask=True)
+    out = keras.layers.Dense(vocab)(h)
+    model = keras.Model(inp, out)
+    model.compile(optimizer="adam",
+                  loss=keras.losses.SparseCategoricalCrossentropy(
+                      from_logits=True))
+    with pytest.raises(ValueError, match="customized subclass"):
+        generate(model, np.array([[1, 2]], np.int32), steps=2,
+                 kv_cache=True)
