@@ -609,13 +609,17 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k, seed):
                             inv = _op._inverse_sqrt_head_dim
                         # one grouped attend covers both: the cache holds
                         # UN-repeated kv heads and query heads attend in
-                        # groups of rep (rep == 1 for plain MHA)
+                        # groups of rep (rep == 1 for plain MHA). keras
+                        # multiplies the QUERY by the inverse-sqrt factor
+                        # BEFORE the dot — matching that operation order
+                        # keeps the float reduction identical to the
+                        # full-recompute path (code-review r4)
                         hq, hkv = q.shape[1], k.shape[1]
                         rep = hq // hkv
-                        qg = q.reshape(q.shape[0], hkv, rep, q.shape[-1])
-                        att = jnp.einsum(
-                            "bgrk,bsgk->bgrs", qg, ck
-                        ) * float(inv)
+                        qg = (q * float(inv)).reshape(
+                            q.shape[0], hkv, rep, q.shape[-1]
+                        )
+                        att = jnp.einsum("bgrk,bsgk->bgrs", qg, ck)
                         visible = (
                             jnp.arange(maxlen)[None, None, None, :] <= t
                         )

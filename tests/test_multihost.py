@@ -751,3 +751,168 @@ def test_gang_elastic_restart_from_checkpoint(tmp_path):
     assert a["phase2_epochs"] == 2, a
     assert a["digest"] == b["digest"], (a, b)
     assert np.all(np.isfinite(a["losses"])), a
+
+
+ELASTIC_TP_SCRIPT = textwrap.dedent(
+    """
+    import hashlib, json, os
+
+    from elephas_tpu.parallel import distributed
+
+    assert distributed.initialize(), "gang init failed"
+    import numpy as np
+    import keras
+    from elephas_tpu import SparkModel
+
+    ckdir = os.environ["ELEPHAS_CHECKPOINT_DIR"]
+    attempt = int(os.environ["ELEPHAS_RESTART_COUNT"])
+    resume = os.environ["ELEPHAS_RESUME"] == "1"
+    pid = int(os.environ["ELEPHAS_PROCESS_ID"])
+
+    rng = np.random.default_rng(7)
+    n, d, k = 256, 8, 3
+    centers = rng.normal(size=(k, d)) * 2.0
+    y = rng.integers(0, k, size=n)
+    x = (centers[y] + rng.normal(size=(n, d)) * 0.5).astype(np.float32)
+    y = y.astype(np.int32)
+
+    keras.utils.set_random_seed(3)
+    model = keras.Sequential([
+        keras.layers.Input((d,)),
+        keras.layers.Dense(32, activation="relu"),
+        keras.layers.Dense(k, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.optimizers.Adam(1e-2),
+                  loss="sparse_categorical_crossentropy")
+
+    # Megatron-sharded weights SPANNING the gang; orbax sharded
+    # checkpoints; a child death mid-run must restart + resume
+    sm = SparkModel(model, model_parallel=2)
+    spans = {dv.process_index for dv in sm.mesh.devices.flat}
+    assert spans == {0, 1}, spans
+    h1 = sm.fit((x, y), epochs=2, batch_size=32,
+                checkpoint_dir=ckdir, resume=resume)
+    if attempt == 0 and pid == 0:
+        os._exit(23)  # this generation, the COORDINATOR dies
+    h2 = sm.fit((x, y), epochs=4, batch_size=32,
+                checkpoint_dir=ckdir, resume=True)
+
+    digest = hashlib.sha256(
+        b"".join(np.ascontiguousarray(w, dtype=np.float32).tobytes()
+                 for w in model.get_weights())
+    ).hexdigest()
+    print("ELASTICTP " + json.dumps({
+        "process": pid,
+        "attempt": attempt,
+        "phase2_epochs": len(h2["loss"]),
+        "losses": [float(v) for v in h2["loss"]],
+        "digest": digest,
+    }), flush=True)
+    """
+)
+
+
+def test_gang_elastic_restart_tensor_parallel(tmp_path):
+    """r4: elastic restart composes with tensor parallelism — a TP gang
+    (weight shards on both processes, orbax sharded checkpoints) loses
+    its COORDINATOR mid-run, relaunches, restores the sharded snapshot,
+    and finishes with identical weights on both processes."""
+    ckdir = os.path.join(str(tmp_path), "elastic_tp_ckpt")
+    os.makedirs(ckdir, exist_ok=True)
+    rc, output = _run_gang(
+        str(tmp_path), ELASTIC_TP_SCRIPT,
+        max_restarts=1, restart_from=ckdir,
+    )
+    assert rc == 0, output[-3000:]
+    # how generation 0 dies races three ways: the launcher kills the
+    # gang after noticing the coordinator's rc=23, OR the peer's
+    # coordination-service abort, OR both processes are already dead by
+    # the next poll (no kill needed) — the restart line is the
+    # deterministic part
+    assert "restarting (1/1)" in output, output[-3000:]
+    results = [
+        json.loads(line.split("ELASTICTP ", 1)[1])
+        for line in output.splitlines()
+        if "ELASTICTP " in line
+    ]
+    assert len(results) == 2, output[-3000:]
+    a, b = sorted(results, key=lambda r: r["process"])
+    assert a["attempt"] == 1 and b["attempt"] == 1, (a, b)
+    assert a["phase2_epochs"] == 2, a
+    assert np.all(np.isfinite(a["losses"])), a
+    assert a["digest"] == b["digest"], (a, b)
+
+
+TPSP_SCRIPT = textwrap.dedent(
+    """
+    import hashlib, json
+
+    from elephas_tpu.parallel import distributed
+
+    assert distributed.initialize(), "gang init failed"
+    import jax
+    import numpy as np
+    import keras
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import transformer_classifier
+
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8
+
+    rng = np.random.default_rng(0)
+    maxlen, vocab, n = 64, 32, 256
+    y = rng.integers(0, 2, size=n).astype(np.int32)
+    x = rng.integers(4, vocab, size=(n, maxlen)).astype(np.int32)
+    pos = rng.integers(0, maxlen // 2, size=n) + np.where(
+        y == 1, maxlen // 2, 0
+    )
+    x[np.arange(n), pos] = 1  # marker task: attention must cross shards
+
+    # same config the single-process SP learning test solves
+    model = transformer_classifier(
+        vocab_size=vocab, maxlen=maxlen, num_classes=2,
+        d_model=32, num_heads=2, num_layers=1, dropout=0.0, lr=1e-2,
+        seed=2,
+    )
+    # 3-D ('data','seq','model') mesh SPANNING both processes: Megatron
+    # weight shards AND ring sequence shards cross the process gap
+    sm = SparkModel(model, sequence_parallel=2, model_parallel=2)
+    assert dict(sm.mesh.shape) == {"data": 2, "seq": 2, "model": 2}
+    spans = {dv.process_index for dv in sm.mesh.devices.flat}
+    assert spans == {0, 1}, spans
+
+    history = sm.fit((x, y), epochs=15, batch_size=32)
+    scores = sm.evaluate(x, y, batch_size=32)
+
+    digest = hashlib.sha256(
+        b"".join(np.ascontiguousarray(w, dtype=np.float32).tobytes()
+                 for w in model.get_weights())
+    ).hexdigest()
+    print("TPSP " + json.dumps({
+        "process": jax.process_index(),
+        "digest": digest,
+        "final_loss": history["loss"][-1],
+        "eval_acc": scores[1] if isinstance(scores, (list, tuple))
+        else scores["accuracy"],
+    }), flush=True)
+    """
+)
+
+
+def test_two_process_tp_sp_composition(tmp_path):
+    """r4: the TP x SP 3-D mesh spans a 2-process gang — Megatron weight
+    shards and the ring-attention KV rotation both cross the process
+    boundary in ONE program, training the cross-shard marker task with
+    identical weights on both processes."""
+    rc, output = _run_gang(str(tmp_path), TPSP_SCRIPT)
+    assert rc == 0, output[-3000:]
+    results = [
+        json.loads(line.split("TPSP ", 1)[1])
+        for line in output.splitlines()
+        if "TPSP " in line
+    ]
+    assert len(results) == 2, output[-3000:]
+    a, b = sorted(results, key=lambda r: r["process"])
+    assert a["digest"] == b["digest"], (a, b)
+    assert np.isfinite(a["final_loss"]), a
+    assert a["eval_acc"] > 0.85, a
