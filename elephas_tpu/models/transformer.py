@@ -261,9 +261,10 @@ def transformer_lm(
     return model
 
 
-def _sample_logits(logits, key, temperature: float, top_k):
+def _sample_logits(logits, key, temperature: float, top_k, top_p=None):
     """Greedy argmax at temperature 0; else temperature-scaled
-    categorical sampling, optionally truncated to the top_k logits.
+    categorical sampling, optionally truncated to the top_k logits
+    and/or the top_p (nucleus) probability mass.
     Shared by the full-recompute and KV-cache decode paths."""
     import jax
     import jax.numpy as jnp
@@ -274,6 +275,19 @@ def _sample_logits(logits, key, temperature: float, top_k):
     if top_k is not None:
         kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)][:, None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p is not None:
+        # nucleus: keep the smallest set of tokens whose cumulative
+        # probability reaches top_p (the first token past the threshold
+        # is kept so the nucleus is never empty)
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < float(top_p)  # prev-cumulative below mass
+        # threshold = smallest kept logit per row
+        kept_min = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        scaled = jnp.where(scaled < kept_min, -jnp.inf, scaled)
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
@@ -283,6 +297,7 @@ def generate(
     steps: int,
     temperature: float = 0.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     seed: int = 0,
     kv_cache: bool = False,
 ):
@@ -292,7 +307,8 @@ def generate(
     model's ``maxlen``). Returns ``[B, P + steps]`` tokens.
     ``temperature=0`` is greedy argmax; otherwise softmax sampling at
     that temperature, optionally truncated to the ``top_k`` most likely
-    tokens.
+    tokens and/or the ``top_p`` nucleus (the smallest set of tokens
+    whose cumulative probability reaches ``top_p``).
 
     TPU-shaped: ONE jitted program — the sequence stays at the model's
     fixed ``maxlen`` (causal attention makes positions ``>= t`` inert),
@@ -321,6 +337,8 @@ def generate(
         raise ValueError(
             f"top_k={top_k} outside (0, vocab={vocab}]"
         )
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError(f"top_p={top_p} outside (0, 1]")
     tv = [v.value for v in model.trainable_variables]
     ntv = [v.value for v in model.non_trainable_variables]
     tokens0 = np.zeros((b, maxlen), np.int32)
@@ -328,7 +346,7 @@ def generate(
 
     if kv_cache:
         return _generate_cached(
-            model, tokens0, b, p, steps, temperature, top_k, seed
+            model, tokens0, b, p, steps, temperature, top_k, top_p, seed
         )
 
     # the compiled loop is cached ON the model, keyed by everything its
@@ -336,7 +354,7 @@ def generate(
     # sampling config) hit the cache, and weights ride as ARGUMENTS so
     # further training never serves stale baked-in constants
     cache = model.__dict__.setdefault("_elephas_generate_jit", {})
-    cache_key = (b, p, steps, float(temperature), top_k)
+    cache_key = (b, p, steps, float(temperature), top_k, top_p)
     run = cache.get(cache_key)
     if run is None:
 
@@ -348,7 +366,9 @@ def generate(
                     tv, ntv, tokens, training=False
                 )
                 key, sub = jax.random.split(key)
-                nxt = _sample_logits(logits[:, t - 1], sub, temperature, top_k)
+                nxt = _sample_logits(
+                    logits[:, t - 1], sub, temperature, top_k, top_p
+                )
                 return tokens.at[:, t].set(nxt), key
 
             tokens, _ = jax.lax.fori_loop(p, p + steps, step, (tokens, key))
@@ -360,7 +380,8 @@ def generate(
     return np.asarray(out[:, : p + steps])
 
 
-def _generate_cached(model, tokens0, b, p, steps, temperature, top_k, seed):
+def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
+                     top_p, seed):
     """KV-cache decode for ANY single-input causal LM assembled from
     ``FlashMHA`` attention plus token-local keras layers.
 
@@ -492,7 +513,7 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k, seed):
     total = p + steps
 
     cache = model.__dict__.setdefault("_elephas_generate_jit", {})
-    cache_key = ("kv", b, p, steps, float(temperature), top_k)
+    cache_key = ("kv", b, p, steps, float(temperature), top_k, top_p)
     run = cache.get(cache_key)
     if run is None:
 
@@ -713,7 +734,7 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k, seed):
                 # paths (r3 advisor finding)
                 key2, sub = jax.random.split(key)
                 key = jnp.where(write, key2, key)
-                nxt = _sample_logits(logits, sub, temperature, top_k)
+                nxt = _sample_logits(logits, sub, temperature, top_k, top_p)
                 tokens = jnp.where(
                     write,
                     tokens.at[:, jnp.minimum(t + 1, maxlen - 1)].set(nxt),

@@ -32,10 +32,12 @@ microbatches over the stage mesh (weights stay depth-sharded), and
 ``evaluate`` aggregates the compiled per-sample loss + metric states
 over the gathered predictions — no device ever holds the full model.
 
-The training history is loss-only (threading metric state through the
-ring would put metric updates on the last stage's critical path); use
-``fit(validation_split=...)`` for per-epoch ``val_*`` metrics — they
-run through the ring evaluator.
+The training history carries the compiled metrics too (r4): the train
+step returns the last stage's predictions as a gradient aux, and keras
+metric states accumulate on HOST from them — nothing lands on the
+ring's critical path. (The streamed ``fit_stream`` path stays
+loss-only.) ``fit(validation_split=...)`` adds per-epoch ``val_*``
+metrics through the ring evaluator.
 """
 
 from __future__ import annotations
@@ -657,7 +659,26 @@ class PipelineRunner:
 
         return [wrapped_cb]
 
+    def _helpers(self, x1, y1):
+        """(introspection, per-sample loss, metric objects) — built once
+        per runner (metric-object creation runs a master-model forward)
+        and shared by training metrics, evaluate, and per-epoch
+        validation (code-review r4)."""
+        if self._eval_helpers is None:
+            from elephas_tpu.worker import KerasIntrospection
+
+            intro = KerasIntrospection()
+            intro.model = self.model
+            self._eval_helpers = (
+                intro,
+                intro._per_sample_loss_fn(),
+                intro._unwrapped_metrics(x1, y1),
+            )
+        return self._eval_helpers
+
     def run_epochs(self, partitions, epochs, batch_size, verbose=0, callbacks=None):
+        import jax.numpy as jnp
+
         if len(partitions) == 1:
             # the pipeline consumes whole batches; avoid a second full
             # host copy of a possibly multi-GB dataset
@@ -665,10 +686,51 @@ class PipelineRunner:
         else:
             x = np.concatenate([np.asarray(p[0]) for p in partitions])
             y = np.concatenate([np.asarray(p[1]) for p in partitions])
+
+        # r4 (closes the r3 loss-only restriction): the train step
+        # collects the last stage's predictions as a gradient aux, and
+        # the compiled-metrics machinery accumulates keras training
+        # metrics ON HOST from them — nothing lands on the ring's
+        # critical path. Same accumulate-over-epoch-then-reset
+        # semantics as keras fit.
+        on_batch = None
+        intro, _per_sample, metric_objects = self._helpers(x[:1], y[:1])
+        tails: list[dict] = []
+        if metric_objects:
+            mvs_box = {"mvs": intro._zero_metric_state(metric_objects)}
+
+            def on_batch(y_pred, rows, valid):
+                yb = jnp.asarray(y[rows])
+                yp = jnp.asarray(y_pred)
+                # wrap-padded duplicate rows carry zero weight so each
+                # real row counts exactly once per epoch, like keras
+                sw = jnp.asarray(valid, jnp.float32)
+                mvs_box["mvs"] = [
+                    m.stateless_update_state(mv, yb, yp, sw)
+                    for (m, _i, _n), mv in zip(
+                        metric_objects, mvs_box["mvs"]
+                    )
+                ]
+
+            def metric_epoch_cb(epoch, loss):
+                tail: dict[str, list[float]] = {}
+                intro._history_from_metrics(
+                    tail, metric_objects, mvs_box["mvs"]
+                )
+                tails.append({k: v[0] for k, v in tail.items()})
+                mvs_box["mvs"] = intro._zero_metric_state(metric_objects)
+
+        extra_cbs = self._wrap_callbacks(callbacks) or []
+        if metric_objects:
+            # metric finalization runs FIRST so user callbacks (per-epoch
+            # validation appends val_* after train metrics) keep order
+            extra_cbs = [metric_epoch_cb] + extra_cbs
         history = self.trainer.fit(
             x, y, epochs=epochs, batch_size=batch_size, verbose=verbose,
-            callbacks=self._wrap_callbacks(callbacks),
+            callbacks=extra_cbs or None, on_batch_outputs=on_batch,
         )
+        for key in tails[0] if tails else ():
+            history[key] = [t[key] for t in tails]
         self._write_back()
         return history
 
@@ -696,20 +758,7 @@ class PipelineRunner:
         y = self._concat_rows([p[1] for p in partitions])
         y_pred = jnp.asarray(self.trainer.predict(x, batch_size=batch_size))
 
-        if self._eval_helpers is None:
-            # per-epoch validation calls this every epoch; the loss fn
-            # and metric objects (whose creation runs a master-model
-            # forward) are identical across calls — build once
-            from elephas_tpu.worker import KerasIntrospection
-
-            intro = KerasIntrospection()
-            intro.model = self.model
-            self._eval_helpers = (
-                intro,
-                intro._per_sample_loss_fn(),
-                intro._unwrapped_metrics(x[:1], y[:1]),
-            )
-        intro, per_sample, metric_objects = self._eval_helpers
+        intro, per_sample, metric_objects = self._helpers(x[:1], y[:1])
         values = per_sample(jnp.asarray(y), y_pred)
         results = {k: float(jnp.mean(values[k])) for k in intro._loss_keys()}
         mvs = [

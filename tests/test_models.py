@@ -421,3 +421,38 @@ def test_generate_kv_cache_rejects_customized_attention_subclass():
     with pytest.raises(ValueError, match="customized subclass"):
         generate(model, np.array([[1, 2]], np.int32), steps=2,
                  kv_cache=True)
+
+
+def test_generate_top_p_nucleus():
+    """r4: top_p nucleus sampling — outputs stay in-vocab, match between
+    the full and cached decode paths at the same seed, and top_p ~ 0
+    degenerates to greedy (the nucleus keeps only the argmax token)."""
+    from elephas_tpu.models import generate, transformer_lm
+
+    maxlen, vocab = 16, 8
+    rng = np.random.default_rng(0)
+    starts = rng.integers(2, 6, size=128)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+    m = transformer_lm(vocab_size=vocab, maxlen=maxlen, d_model=32,
+                       num_heads=2, num_layers=1, dropout=0.0, lr=1e-2,
+                       seed=0)
+    m.fit(x, y, epochs=6, batch_size=32, verbose=0)
+
+    prompt = np.array([[2, 3, 4, 5]], np.int32)
+    s_full = generate(m, prompt, steps=6, temperature=0.8, top_p=0.9,
+                      seed=2)
+    assert s_full.min() >= 0 and s_full.max() < vocab
+    s_cached = generate(m, prompt, steps=6, temperature=0.8, top_p=0.9,
+                        seed=2, kv_cache=True)
+    np.testing.assert_array_equal(s_cached, s_full)
+
+    # a vanishing nucleus keeps only the most likely token == greedy
+    greedy = generate(m, prompt, steps=6)
+    tiny_p = generate(m, prompt, steps=6, temperature=1.0, top_p=1e-6,
+                      seed=5)
+    np.testing.assert_array_equal(tiny_p, greedy)
+
+    import pytest
+    with pytest.raises(ValueError, match="top_p"):
+        generate(m, prompt, steps=2, top_p=1.5)

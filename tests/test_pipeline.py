@@ -366,6 +366,11 @@ def test_pipeline_parallel_matches_single_device(blobs):
     np.testing.assert_allclose(
         h_pp["loss"], h_ref.history["loss"], rtol=1e-3
     )
+    # r4: the training history carries the compiled metrics too
+    assert "accuracy" in h_pp, h_pp.keys()
+    np.testing.assert_allclose(
+        h_pp["accuracy"], h_ref.history["accuracy"], rtol=1e-3
+    )
     for a, b in zip(sm.master_network.get_weights(), ref.get_weights()):
         np.testing.assert_allclose(a, b, atol=2e-3, rtol=2e-3)
 
@@ -976,3 +981,27 @@ def test_pipeline_rejects_cross_stage_weight_tying():
     with pytest.raises(ValueError, match="weight tying across"):
         SparkModel(m, pipeline_parallel=2).fit((x, y), epochs=1,
                                                batch_size=16)
+
+
+def test_pipeline_metrics_zero_weight_padded_rows(blobs):
+    """code-review r4: when n doesn't divide the effective batch, the
+    final batch wrap-pads duplicate rows — training METRICS must
+    zero-weight them (each real row counts once per epoch). Epoch 1 is
+    then exactly keras (metric updates happen pre-gradient-step, so the
+    padded batch's different update only affects later epochs)."""
+    import keras
+
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    x, y = x[:200], y[:200]  # 200 rows, batch 64 -> 8 wrap-padded
+
+    sm = SparkModel(_pp_mlp(d, k, seed=91), pipeline_parallel=2,
+                    pipeline_microbatches=1)
+    h_pp = sm.fit((x, y), epochs=1, batch_size=64)
+
+    ref = _pp_mlp(d, k, seed=91)
+    h_ref = ref.fit(x, y, epochs=1, batch_size=64, shuffle=False, verbose=0)
+    np.testing.assert_allclose(
+        h_pp["accuracy"], h_ref.history["accuracy"], rtol=1e-5
+    )
