@@ -62,6 +62,12 @@ class ImplausibleTiming(RuntimeError):
     """A timed window that physics rules out (see BENCH_r03.json)."""
 
 
+class DivergedRun(RuntimeError):
+    """The measured training itself diverged (NaN loss) — a MODEL
+    problem, not a timing-instrument problem; retrying the measurement
+    cannot fix it (code-review r4)."""
+
+
 def require_credible(dt, ips_chip, flops_per_img, peak):
     """Reject measurements that violate hard physical bounds.
 
@@ -193,8 +199,10 @@ def measure_spark_fit(model, x, y, batch_size, epochs, num_workers,
         final_loss = float(np.asarray(losses).ravel()[-1])
         dt = time.perf_counter() - t0
     if final_loss != final_loss:
-        raise ImplausibleTiming("final epoch loss is NaN — measured run "
-                                "did not perform credible training work")
+        raise DivergedRun(
+            "final epoch loss is NaN — the training configuration "
+            "diverged; fix the model/preset, re-measuring cannot help"
+        )
     if not (dt > MIN_CREDIBLE_DT):
         raise ImplausibleTiming(
             f"timed window {dt:.4f}s is below the {MIN_CREDIBLE_DT}s "
@@ -547,6 +555,9 @@ def main():
             )
             require_credible(dt, ips / n_chips, flops_per_img, peak)
             break
+        except DivergedRun as e:
+            log.error("training diverged — not a timing problem: %s", e)
+            sys.exit(2)
         except ImplausibleTiming as e:
             log.warning(
                 "headline attempt %d/%d implausible: %s",

@@ -477,13 +477,28 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
                 f"kv_cache decode is causal by construction, but FlashMHA "
                 f"layer {l.name!r} has causal=False; use kv_cache=False"
             )
+    # count call sites within THIS model's graph only — inbound nodes
+    # accumulate across every symbolic call a layer ever received, so a
+    # layer also referenced by some other Model would be spuriously
+    # rejected by a global count (code-review r4)
+    calls_here: dict[int, int] = {}
+    nodes_by_depth = getattr(model, "_nodes_by_depth", None)
+    if nodes_by_depth is None:  # fall back to the (global) node count
+        for l in flash_layers + stock_mha_layers + gqa_layers:
+            calls_here[id(l)] = len(l._inbound_nodes)
+    else:
+        for depth_nodes in nodes_by_depth.values():
+            for node in depth_nodes:
+                op = getattr(node, "operation", None)
+                if op is not None:
+                    calls_here[id(op)] = calls_here.get(id(op), 0) + 1
     for l in flash_layers + stock_mha_layers + gqa_layers:
-        if len(l._inbound_nodes) > 1:
+        if calls_here.get(id(l), 0) > 1:
             # weight-tied reuse (ALBERT-style): every call site would
             # share ONE name-keyed cache and clobber the others' K/V
             raise ValueError(
                 f"kv_cache decode keys K/V caches by layer, but "
-                f"{l.name!r} is called at {len(l._inbound_nodes)} graph "
+                f"{l.name!r} is called at {calls_here[id(l)]} graph "
                 f"nodes (weight tying) — the call sites would corrupt "
                 f"each other's cache; use kv_cache=False"
             )

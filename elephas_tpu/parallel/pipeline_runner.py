@@ -694,7 +694,25 @@ class PipelineRunner:
         # critical path. Same accumulate-over-epoch-then-reset
         # semantics as keras fit.
         on_batch = None
-        intro, _per_sample, metric_objects = self._helpers(x[:1], y[:1])
+        metric_objects = []
+        intro = None
+        # only models with COMPILED metrics pay the helper build (whose
+        # metric-object creation runs a one-row master-model forward on
+        # one device — unaffordable exactly when the model is pipelined
+        # because it doesn't fit one device, so degrade to loss-only
+        # with a warning rather than OOM; code-review r4)
+        if getattr(self.model, "_compile_metrics", None) is not None:
+            try:
+                intro, _per_sample, metric_objects = self._helpers(
+                    x[:1], y[:1]
+                )
+            except Exception as exc:
+                logger.warning(
+                    "pipeline_parallel: could not build the training-"
+                    "metric machinery (%s) — history will be loss-only",
+                    exc,
+                )
+                metric_objects = []
         tails: list[dict] = []
         if metric_objects:
             mvs_box = {"mvs": intro._zero_metric_state(metric_objects)}
@@ -814,7 +832,26 @@ class PipelineRunner:
             "state": abstract(self.trainer.state),
             "opt": jax.tree.map(abstract, self.trainer.opt_state),
         }
-        found = ckpt.restore_sharded_checkpoint(directory, target)
+        try:
+            found = ckpt.restore_sharded_checkpoint(directory, target)
+        except Exception:
+            # pre-0.5.0 snapshots carry no "state" entry (BN state is
+            # new); restore params+opt and keep the current
+            # non-trainable state instead of wedging every elastic
+            # restart generation (code-review r4)
+            legacy = {k: target[k] for k in ("params", "opt")}
+            found = ckpt.restore_sharded_checkpoint(directory, legacy)
+            if found is not None:
+                tree, meta = found
+                logger.warning(
+                    "pipeline_parallel: restored a pre-0.5.0 checkpoint "
+                    "without non-trainable state; BN statistics resume "
+                    "from their current values"
+                )
+                self.trainer.params = tree["params"]
+                self.trainer.opt_state = tree["opt"]
+                self._write_back()
+                return meta
         if found is None:
             return None
         tree, meta = found

@@ -456,3 +456,35 @@ def test_generate_top_p_nucleus():
     import pytest
     with pytest.raises(ValueError, match="top_p"):
         generate(m, prompt, steps=2, top_p=1.5)
+
+
+def test_generate_kv_cache_layer_shared_with_other_model():
+    """code-review r4: the weight-tying guard counts call sites within
+    THIS model's graph — a layer also referenced by a second Model
+    (probe/feature-extractor pattern) must not be spuriously rejected."""
+    import keras
+
+    from elephas_tpu.models import generate
+    from elephas_tpu.models.transformer import FlashMHA
+
+    maxlen, vocab, d = 8, 8, 16
+    keras.utils.set_random_seed(11)
+    inp = keras.Input((maxlen,), dtype="int32")
+    emb = keras.layers.Embedding(vocab, d)
+    att = FlashMHA(2, d // 2, causal=True, name="shared_attn")
+    h = att(emb(inp))
+    out = keras.layers.Dense(vocab)(h)
+    lm = keras.Model(inp, out)
+    lm.compile(optimizer="adam",
+               loss=keras.losses.SparseCategoricalCrossentropy(
+                   from_logits=True))
+
+    # a second model reusing the same layers (adds inbound nodes that
+    # do NOT belong to lm's graph)
+    inp2 = keras.Input((maxlen,), dtype="int32")
+    probe = keras.Model(inp2, att(emb(inp2)))  # noqa: F841
+
+    prompt = np.array([[1, 2]], np.int32)
+    full = generate(lm, prompt, steps=3)
+    cached = generate(lm, prompt, steps=3, kv_cache=True)
+    np.testing.assert_array_equal(cached, full)

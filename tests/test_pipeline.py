@@ -1005,3 +1005,32 @@ def test_pipeline_metrics_zero_weight_padded_rows(blobs):
     np.testing.assert_allclose(
         h_pp["accuracy"], h_ref.history["accuracy"], rtol=1e-5
     )
+
+
+def test_pipeline_restores_pre_050_checkpoint(tmp_path, blobs):
+    """code-review r4: snapshots written before the BN-state buffer
+    existed carry only params+opt — resume must restore them (keeping
+    current non-trainable state) instead of wedging every elastic
+    restart generation on a tree-structure mismatch."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.utils import checkpoint as ckpt
+
+    x, y, d, k = blobs
+    sm = SparkModel(_pp_mlp(d, k, seed=51), pipeline_parallel=2,
+                    pipeline_microbatches=1)
+    sm.fit((x[:128], y[:128]), epochs=1, batch_size=32)
+    runner = sm._get_runner()
+    legacy_dir = str(tmp_path / "old_ckpt")
+    # write a LEGACY-format snapshot: params + opt only, no "state"
+    ckpt.save_sharded_checkpoint(
+        legacy_dir, 1,
+        {"params": runner.trainer.params, "opt": runner.trainer.opt_state},
+        {"epoch": 1, "history": {}},
+    )
+
+    sm2 = SparkModel(_pp_mlp(d, k, seed=51), pipeline_parallel=2,
+                     pipeline_microbatches=1)
+    h = sm2.fit((x[:128], y[:128]), epochs=3, batch_size=32,
+                checkpoint_dir=legacy_dir, resume=True)
+    assert len(h["loss"]) == 2, h  # resumed at epoch 1, ran 2 more
+    assert np.all(np.isfinite(h["loss"])), h
