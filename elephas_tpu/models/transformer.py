@@ -21,6 +21,7 @@ as absent upstream — TPU-native extension, not a port).
 from __future__ import annotations
 
 import contextlib
+import functools
 
 import numpy as np
 
@@ -66,11 +67,16 @@ def _flash_mha_layer():
         """
 
         def __init__(self, num_heads: int, head_dim: int, causal: bool = False,
-                     **kwargs):
+                     rope: bool = False, **kwargs):
             super().__init__(**kwargs)
             self.num_heads = num_heads
             self.head_dim = head_dim
             self.causal = causal
+            self.rope = rope
+            if rope and head_dim % 2:
+                raise ValueError(
+                    f"rope needs an even head_dim, got {head_dim}"
+                )
 
         def build(self, input_shape):
             d_model = int(input_shape[-1])
@@ -91,7 +97,10 @@ def _flash_mha_layer():
                 active_sequence_scope, ring_mha,
             )
 
-            from elephas_tpu.ops.flash_attention import flash_attention_qkv
+            from elephas_tpu.ops.flash_attention import (
+                flash_attention,
+                flash_attention_qkv,
+            )
 
             B = jnp.shape(x)[0]
             S = x.shape[1]
@@ -99,15 +108,26 @@ def _flash_mha_layer():
             qkv = self.qkv(x)  # [B, S, 3*H*D]
             qkv = jnp.reshape(qkv, (B, S, 3, H, D))
             scope = active_sequence_scope()
-            if scope is not None:
-                # sequence-parallel region: the S axis is sharded over
-                # the mesh — ring the KV shards instead of running the
-                # single-chip flash kernel on a gathered sequence
+            if scope is not None or self.rope:
+                # transposed path: the SP ring wants separate q/k/v, and
+                # rope must rotate q/k between the projection and the
+                # kernel (which forfeits the packed kernel's zero-copy
+                # read — one layout copy, the price of rotation)
                 qkv_t = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3,B,H,S,D]
-                out = ring_mha(
-                    qkv_t[0], qkv_t[1], qkv_t[2], causal=self.causal,
-                    scope=scope,
-                )
+                q, k, v = qkv_t[0], qkv_t[1], qkv_t[2]
+                if self.rope:
+                    cos, sin = _rope_tables(S, D)
+                    cos = jnp.asarray(cos, x.dtype)[None, None]
+                    sin = jnp.asarray(sin, x.dtype)[None, None]
+                    # positionwise over the GLOBAL sequence, so under a
+                    # sequence scope GSPMD shards the rotation with the
+                    # activations — ring semantics are unchanged
+                    q = _apply_rope(q, cos, sin)
+                    k = _apply_rope(k, cos, sin)
+                if scope is not None:
+                    out = ring_mha(q, k, v, causal=self.causal, scope=scope)
+                else:
+                    out = flash_attention(q, k, v, causal=self.causal)
                 out = jnp.reshape(
                     jnp.transpose(out, (0, 2, 1, 3)), (B, S, H * D)
                 )
@@ -127,6 +147,7 @@ def _flash_mha_layer():
                 num_heads=self.num_heads,
                 head_dim=self.head_dim,
                 causal=self.causal,
+                rope=self.rope,
             )
             return config
 
@@ -142,9 +163,11 @@ def __getattr__(name):
     raise AttributeError(name)
 
 
-def _block(x, num_heads, head_dim, mlp_ratio, dropout, causal, name, L, FlashMHA):
+def _block(x, num_heads, head_dim, mlp_ratio, dropout, causal, name, L,
+           FlashMHA, rope=False):
     h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln1")(x)
-    h = FlashMHA(num_heads, head_dim, causal=causal, name=f"{name}_attn")(h)
+    h = FlashMHA(num_heads, head_dim, causal=causal, rope=rope,
+                 name=f"{name}_attn")(h)
     if dropout > 0:
         # rate-0 Dropout layers are elided entirely: dead ops, and their
         # python `if training` branch breaks keras.RematScope (jax.remat
@@ -167,6 +190,31 @@ def _positions(maxlen: int, d_model: int) -> np.ndarray:
     angle = pos / np.power(10000.0, (2 * (i // 2)) / d_model)
     table = np.where(i % 2 == 0, np.sin(angle), np.cos(angle))
     return table.astype(np.float32)
+
+
+@functools.lru_cache(maxsize=8)
+def _rope_tables(maxlen: int, head_dim: int):
+    """cos/sin tables ``[S, D]`` for rotary position embeddings
+    (half-split / GPT-NeoX convention; ``head_dim`` must be even).
+    Cached so every attention layer shares ONE host table (and jax sees
+    one constant object) instead of L identical copies — at long-context
+    sequence lengths the table is large (code-review r4)."""
+    inv = 1.0 / (10000.0 ** (np.arange(0, head_dim, 2) / head_dim))
+    ang = np.arange(maxlen)[:, None] * inv[None, :]  # [S, D/2]
+    cos = np.concatenate([np.cos(ang), np.cos(ang)], axis=-1)
+    sin = np.concatenate([np.sin(ang), np.sin(ang)], axis=-1)
+    return cos.astype(np.float32), sin.astype(np.float32)
+
+
+def _apply_rope(x, cos, sin):
+    """Rotate ``[..., S, D]`` (or ``[..., D]`` single-position) heads:
+    ``x·cos + rotate_half(x)·sin`` with broadcastable tables."""
+    import jax.numpy as jnp
+
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos + rot * sin
 
 
 def transformer_classifier(
@@ -230,11 +278,17 @@ def transformer_lm(
     lr: float = 3e-4,
     seed: int = 0,
     dtype_policy: str | None = None,
+    rope: bool = False,
 ):
     """Decoder-only causal LM (next-token prediction).
 
     ``dtype_policy='mixed_bfloat16'`` keeps the matmuls (and the flash
-    attention kernel) in bf16 on the MXU; the lm_head logits stay f32."""
+    attention kernel) in bf16 on the MXU; the lm_head logits stay f32.
+    ``rope=True`` (r4) uses rotary position embeddings in every
+    attention layer instead of the additive sinusoidal table — the
+    modern-LLM positional scheme; composes with the sequence-parallel
+    ring (rotation is positionwise over the global sequence) and with
+    KV-cache decode."""
     keras = _keras()
     keras.utils.set_random_seed(seed)
     with _dtype_policy_scope(keras, dtype_policy):
@@ -244,11 +298,12 @@ def transformer_lm(
 
         inputs = keras.Input((maxlen,), dtype="int32")
         x = L.Embedding(vocab_size, d_model, name="tok_embed")(inputs)
-        x = x + _positions(maxlen, d_model)[None]
+        if not rope:
+            x = x + _positions(maxlen, d_model)[None]
         for b in range(num_layers):
             x = _block(
                 x, num_heads, head_dim, mlp_ratio, dropout, True,
-                f"blk{b}", L, FlashMHA,
+                f"blk{b}", L, FlashMHA, rope=rope,
             )
         x = L.LayerNormalization(epsilon=1e-6, name="final_ln")(x)
         outputs = L.Dense(vocab_size, name="lm_head", dtype="float32")(x)
@@ -493,14 +548,26 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
                 if op is not None:
                     calls_here[id(op)] = calls_here.get(id(op), 0) + 1
     for l in flash_layers + stock_mha_layers + gqa_layers:
-        if calls_here.get(id(l), 0) > 1:
+        n_calls = calls_here.get(id(l), 0)
+        if n_calls > 1:
             # weight-tied reuse (ALBERT-style): every call site would
             # share ONE name-keyed cache and clobber the others' K/V
             raise ValueError(
                 f"kv_cache decode keys K/V caches by layer, but "
-                f"{l.name!r} is called at {calls_here[id(l)]} graph "
+                f"{l.name!r} is called at {n_calls} graph "
                 f"nodes (weight tying) — the call sites would corrupt "
                 f"each other's cache; use kv_cache=False"
+            )
+        if n_calls == 0 and nodes_by_depth is not None:
+            # reachable only through a NESTED sub-Model's graph: the
+            # decode handler would never intercept it (the replay calls
+            # the inner Model as one opaque layer) — reject with
+            # guidance instead of dying mid-trace (code-review r4)
+            raise ValueError(
+                f"kv_cache decode: attention layer {l.name!r} lives "
+                f"inside a nested sub-Model — the token-by-token replay "
+                f"only walks the top-level graph; flatten the model or "
+                f"use kv_cache=False"
             )
     _SEQ_MIXING = (
         keras.layers.GlobalAveragePooling1D, keras.layers.AveragePooling1D,
@@ -568,6 +635,15 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
                             qkv.reshape(x.shape[0], 3, H, Dh), 3, axis=1
                         )
                         q, k, v = q[:, 0], k[:, 0], v[:, 0]  # [B, H, Dh]
+                        if getattr(op, "rope", False):
+                            # rotate THIS position's q and k before they
+                            # enter the cache/attend — cached k stay
+                            # rotated, matching the full forward
+                            cos_np, sin_np = _rope_tables(maxlen, Dh)
+                            cos_t = jnp.asarray(cos_np)[t]
+                            sin_t = jnp.asarray(sin_np)[t]
+                            q = _apply_rope(q, cos_t, sin_t)
+                            k = _apply_rope(k, cos_t, sin_t)
                         ck = ck.at[:, t].set(k)
                         cv = cv.at[:, t].set(v)
                         att = jnp.einsum("bhd,bshd->bhs", q, ck) * (

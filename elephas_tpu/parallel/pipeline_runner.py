@@ -832,13 +832,23 @@ class PipelineRunner:
             "state": abstract(self.trainer.state),
             "opt": jax.tree.map(abstract, self.trainer.opt_state),
         }
-        try:
-            found = ckpt.restore_sharded_checkpoint(directory, target)
-        except Exception:
-            # pre-0.5.0 snapshots carry no "state" entry (BN state is
-            # new); restore params+opt and keep the current
-            # non-trainable state instead of wedging every elastic
-            # restart generation (code-review r4)
+        # pre-0.5.0 snapshots carry no "state" entry (BN state is new).
+        # Probe the snapshot's actual tree — orbax records every tree
+        # key in its _METADATA json — so only a genuinely legacy
+        # snapshot takes the params+opt fallback; corruption or shape
+        # mismatches in a CURRENT-format snapshot still surface as
+        # errors (code-review r4)
+        import os as _os
+
+        def _snapshot_has_state(path) -> bool:
+            try:
+                with open(_os.path.join(path, "_METADATA")) as fh:
+                    return "('state'" in fh.read()
+            except OSError:
+                return True  # cannot probe — assume current format
+
+        latest = ckpt.latest_sharded_checkpoint(directory)
+        if latest is not None and not _snapshot_has_state(latest[0]):
             legacy = {k: target[k] for k in ("params", "opt")}
             found = ckpt.restore_sharded_checkpoint(directory, legacy)
             if found is not None:
@@ -852,6 +862,7 @@ class PipelineRunner:
                 self.trainer.opt_state = tree["opt"]
                 self._write_back()
                 return meta
+        found = ckpt.restore_sharded_checkpoint(directory, target)
         if found is None:
             return None
         tree, meta = found

@@ -363,7 +363,10 @@ class ShardedTrainer(KerasIntrospection):
             (loss, (ntv2, y_pred)), grads = grad_fn(tv, ntv, x, y, sw)
             tv2, ov2 = optimizer.stateless_apply(ov, grads, tv)
             mvs2 = [
-                m.stateless_update_state(mv, y, y_pred, sample_weight=sw)
+                m.stateless_update_state(
+                    mv, y, y_pred,
+                    sample_weight=self._broadcast_sw(sw, y),
+                )
                 for (m, _i, _n), mv in zip(metric_objects, mvs)
             ]
             return tv2, ntv2, ov2, mvs2, loss
@@ -658,7 +661,10 @@ class ShardedTrainer(KerasIntrospection):
                 yi = y[i] if multi else y
                 ypi = y_pred[i] if multi else y_pred
                 mvs2.append(
-                    m.stateless_update_state(mv, yi, ypi, sample_weight=w)
+                    m.stateless_update_state(
+                        mv, yi, ypi,
+                        sample_weight=self._broadcast_sw(w, yi),
+                    )
                 )
             return mvs2, sums, wsum
 

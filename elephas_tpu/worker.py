@@ -281,6 +281,18 @@ class KerasIntrospection:
             else:
                 history.setdefault(name, []).append(float(np.asarray(res)))
 
+    @staticmethod
+    def _broadcast_sw(sw, y):
+        """Per-ROW sample weights ``[B]`` gain trailing singleton axes
+        so they broadcast against rank>1 targets — a sequence model's
+        per-token loss/metric is ``[B, S]`` and a flat ``[B]`` weight
+        fails jnp broadcasting (found driving an LM through the L5
+        sequence-parallel route, r4)."""
+        y_rank = getattr(y, "ndim", 1)
+        if sw is not None and getattr(sw, "ndim", 1) == 1 and y_rank > 1:
+            return sw.reshape(sw.shape + (1,) * (y_rank - 1))
+        return sw
+
     def _stateless_loss(self, tv, ntv, x, y, sample_weight=None):
         """Forward pass + total training loss with differentiable
         add_loss/regularizer contributions.
@@ -307,7 +319,9 @@ class KerasIntrospection:
         try:
             kwargs = {}
             if sample_weight is not None:
-                kwargs["sample_weight"] = sample_weight
+                kwargs["sample_weight"] = self._broadcast_sw(
+                    sample_weight, y
+                )
             total = model.compute_loss(x=x, y=y, y_pred=y_pred, **kwargs)
         finally:
             if losses:
@@ -690,7 +704,10 @@ class MeshRunner(KerasIntrospection):
                     yi = y[i] if multi else y
                     ypi = y_pred[i] if multi else y_pred
                     new_mvs.append(
-                        m.stateless_update_state(mv, yi, ypi, sample_weight=w)
+                        m.stateless_update_state(
+                            mv, yi, ypi,
+                            sample_weight=self._broadcast_sw(w, yi),
+                        )
                     )
                 return (loss_sums, weight_sum, new_mvs), None
 
