@@ -346,6 +346,76 @@ def _sample_logits(logits, key, temperature: float, top_k, top_p=None):
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
+def _mesh_fingerprint(mesh, batch_axes, model_axis):
+    """Hashable identity of a decode mesh for the jit cache — axis
+    layout plus the concrete device set (hyperparam trials lease many
+    distinct submeshes over the same process lifetime)."""
+    if mesh is None:
+        return None
+    return (
+        tuple(mesh.shape.items()),
+        tuple(d.id for d in mesh.devices.flat),
+        batch_axes,
+        model_axis,
+    )
+
+
+def _decode_jit_cache(model) -> dict:
+    """The per-model compiled-decode cache, BOUNDED: mesh-fingerprinted
+    keys would otherwise pin every leased submesh (devices + compiled
+    executables) alive for the model's lifetime (hyperparam trials lease
+    many). Insertion-ordered eviction, same spirit as the lru-bounded
+    gather cache in :mod:`elephas_tpu.parallel.mesh`."""
+    cache = model.__dict__.setdefault("_elephas_generate_jit", {})
+    while len(cache) > 16:
+        cache.pop(next(iter(cache)))
+    return cache
+
+
+def _finish_decode(model, run, wargs, tokens0, key, mesh, batch_axes,
+                   n_rows, n_cols):
+    """Shared decode epilogue: stage the tokens/key (sharded under
+    ``mesh`` if given), execute the compiled loop, record the
+    out-sharding introspection hook, and host-read the real rows."""
+    import jax.numpy as jnp
+
+    if mesh is None:
+        out = run(*wargs, jnp.asarray(tokens0), key)
+        model.__dict__["_elephas_generate_out_sharding"] = getattr(
+            out, "sharding", None
+        )
+        return np.asarray(out[:n_rows, :n_cols])
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from elephas_tpu.parallel.mesh import host_read, put_global
+
+    tokens = put_global(tokens0, NamedSharding(mesh, P(batch_axes)))
+    out = run(
+        *wargs, tokens,
+        put_global(np.asarray(key), NamedSharding(mesh, P())),
+    )
+    # introspection hook: tests (and curious users) can check the decode
+    # really ran batch-sharded rather than replicated
+    model.__dict__["_elephas_generate_out_sharding"] = out.sharding
+    return host_read(out, mesh)[:n_rows, :n_cols]
+
+
+def _decode_shardings(variables, mesh, model_axis, rules):
+    """Per-variable NamedShardings for decoding under ``mesh``: the TP
+    planner's layouts when a >1 ``model_axis`` exists, replicated
+    otherwise (data/seq/stage axes shard the batch, never weights)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if model_axis is not None and mesh.shape.get(model_axis, 1) > 1:
+        from elephas_tpu.parallel.tensor import plan_sharding
+
+        return plan_sharding(
+            variables, mesh, model_axis=model_axis, rules=rules
+        )
+    return [NamedSharding(mesh, P())] * len(variables)
+
+
 def generate(
     model,
     prompt,
@@ -355,6 +425,10 @@ def generate(
     top_p: float | None = None,
     seed: int = 0,
     kv_cache: bool = False,
+    mesh=None,
+    batch_axes=("data",),
+    model_axis: str | None = None,
+    rules=None,
 ):
     """Autoregressive sampling from a :func:`transformer_lm` model.
 
@@ -373,6 +447,20 @@ def generate(
     cached decode — per-layer K/V caches, one token's compute per step
     (O(S·L) total) — same greedy outputs, built for
     :func:`transformer_lm`'s architecture specifically.
+
+    Mesh-aware decode (r5, VERDICT r4 #1 — the LM analogue of the
+    reference's distributed ``predict``, SURVEY.md §3.4): pass ``mesh``
+    and the decode runs as ONE GSPMD program over it — the batch shards
+    over ``batch_axes`` (padded up to their product and sliced back),
+    and with a >1 ``model_axis`` the weights stay sharded through
+    ``stateless_call`` under the TP planner's layouts (qkv
+    column-split, proj row-split, vocab-sharded head — ``rules``
+    overrides), so models that only fit sharded can decode at all.
+    Under ``kv_cache=True`` the per-layer K/V caches shard batch over
+    ``batch_axes`` and heads over ``model_axis``. Weights ride as jit
+    arguments (host→mesh upload per call — decode loops dominate, the
+    upload does not). Every gang process must make the identical call
+    (SPMD contract); all return the full tokens.
     """
     import jax
     import jax.numpy as jnp
@@ -394,22 +482,47 @@ def generate(
         )
     if top_p is not None and not 0.0 < float(top_p) <= 1.0:
         raise ValueError(f"top_p={top_p} outside (0, 1]")
-    tv = [v.value for v in model.trainable_variables]
-    ntv = [v.value for v in model.non_trainable_variables]
-    tokens0 = np.zeros((b, maxlen), np.int32)
-    tokens0[:, :p] = prompt
+
+    pad = 0
+    if mesh is not None:
+        if isinstance(batch_axes, str):
+            batch_axes = (batch_axes,)
+        batch_axes = tuple(batch_axes)
+        missing = [a for a in batch_axes if a not in mesh.shape]
+        if missing:
+            raise ValueError(
+                f"batch_axes {missing} not in mesh axes "
+                f"{tuple(mesh.shape)}"
+            )
+        dp = int(np.prod([mesh.shape[a] for a in batch_axes]))
+        pad = (-b) % dp
+    bt = b + pad
+    tokens0 = np.zeros((bt, maxlen), np.int32)
+    tokens0[:b, :p] = prompt
+    if pad:
+        # padded lanes decode real math on a copy of the last prompt row
+        # (any in-vocab content works — they are sliced off below)
+        tokens0[b:, :p] = prompt[-1]
 
     if kv_cache:
         return _generate_cached(
-            model, tokens0, b, p, steps, temperature, top_k, top_p, seed
+            model, tokens0, bt, p, steps, temperature, top_k, top_p, seed,
+            mesh=mesh, batch_axes=batch_axes, model_axis=model_axis,
+            rules=rules, n_real=b,
         )
+
+    tv = [v.value for v in model.trainable_variables]
+    ntv = [v.value for v in model.non_trainable_variables]
 
     # the compiled loop is cached ON the model, keyed by everything its
     # program shape depends on — repeat calls (same prompt shape and
     # sampling config) hit the cache, and weights ride as ARGUMENTS so
     # further training never serves stale baked-in constants
-    cache = model.__dict__.setdefault("_elephas_generate_jit", {})
-    cache_key = (b, p, steps, float(temperature), top_k, top_p)
+    cache = _decode_jit_cache(model)
+    cache_key = (
+        bt, p, steps, float(temperature), top_k, top_p,
+        _mesh_fingerprint(mesh, batch_axes, model_axis),
+    )
     run = cache.get(cache_key)
     if run is None:
 
@@ -431,12 +544,26 @@ def generate(
 
         cache[cache_key] = run
 
-    out = run(tv, ntv, jnp.asarray(tokens0), jax.random.PRNGKey(seed))
-    return np.asarray(out[:, : p + steps])
+    if mesh is not None:
+        from elephas_tpu.parallel.mesh import put_global
+
+        tv_sh = _decode_shardings(
+            model.trainable_variables, mesh, model_axis, rules
+        )
+        ntv_sh = _decode_shardings(
+            model.non_trainable_variables, mesh, model_axis, rules
+        )
+        tv = [put_global(np.asarray(v), s) for v, s in zip(tv, tv_sh)]
+        ntv = [put_global(np.asarray(v), s) for v, s in zip(ntv, ntv_sh)]
+    return _finish_decode(
+        model, run, (tv, ntv), tokens0, jax.random.PRNGKey(seed),
+        mesh, batch_axes, b, p + steps,
+    )
 
 
 def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
-                     top_p, seed):
+                     top_p, seed, mesh=None, batch_axes=("data",),
+                     model_axis=None, rules=None, n_real=None):
     """KV-cache decode for ANY single-input causal LM assembled from
     ``FlashMHA`` attention plus token-local keras layers.
 
@@ -590,12 +717,47 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
             f"mixed-precision models"
         )
 
-    weights = {v.path: v.value for v in model.variables}
     maxlen = tokens0.shape[1]
     total = p + steps
 
-    cache = model.__dict__.setdefault("_elephas_generate_jit", {})
-    cache_key = ("kv", b, p, steps, float(temperature), top_k, top_p)
+    if mesh is None:
+        weights = {v.path: v.value for v in model.variables}
+
+        def _constrain_cache(z, heads):
+            return z
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from elephas_tpu.parallel.mesh import put_global
+
+        var_sh = _decode_shardings(
+            list(model.variables), mesh, model_axis, rules
+        )
+        weights = {
+            v.path: put_global(np.asarray(v.value), s)
+            for v, s in zip(model.variables, var_sh)
+        }
+
+        def _constrain_cache(z, heads):
+            # [B, S, H, Dh] K/V cache: batch over the batch axes, heads
+            # over the model axis when they tile (GQA kv-head counts may
+            # not divide — those caches stay head-replicated)
+            ax = (
+                model_axis
+                if model_axis is not None
+                and mesh.shape.get(model_axis, 1) > 1
+                and heads % mesh.shape[model_axis] == 0
+                else None
+            )
+            return jax.lax.with_sharding_constraint(
+                z, NamedSharding(mesh, P(batch_axes, None, ax, None))
+            )
+
+    cache = _decode_jit_cache(model)
+    cache_key = (
+        "kv", b, p, steps, float(temperature), top_k, top_p,
+        _mesh_fingerprint(mesh, batch_axes, model_axis),
+    )
     run = cache.get(cache_key)
     if run is None:
 
@@ -780,35 +942,56 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
         def run(w, tokens, key):
             caches = {
                 l.name: (
-                    jnp.zeros(
-                        (b, maxlen, l.num_heads, l.head_dim), jnp.float32
+                    _constrain_cache(
+                        jnp.zeros(
+                            (b, maxlen, l.num_heads, l.head_dim),
+                            jnp.float32,
+                        ),
+                        l.num_heads,
                     ),
-                    jnp.zeros(
-                        (b, maxlen, l.num_heads, l.head_dim), jnp.float32
+                    _constrain_cache(
+                        jnp.zeros(
+                            (b, maxlen, l.num_heads, l.head_dim),
+                            jnp.float32,
+                        ),
+                        l.num_heads,
                     ),
                 )
                 for l in flash_layers
             }
             for l in stock_mha_layers:
                 caches[l.name] = (
-                    jnp.zeros(
-                        (b, maxlen, l._num_heads, l._key_dim), jnp.float32
+                    _constrain_cache(
+                        jnp.zeros(
+                            (b, maxlen, l._num_heads, l._key_dim),
+                            jnp.float32,
+                        ),
+                        l._num_heads,
                     ),
-                    jnp.zeros(
-                        (b, maxlen, l._num_heads,
-                         l._value_dim or l._key_dim),
-                        jnp.float32,
+                    _constrain_cache(
+                        jnp.zeros(
+                            (b, maxlen, l._num_heads,
+                             l._value_dim or l._key_dim),
+                            jnp.float32,
+                        ),
+                        l._num_heads,
                     ),
                 )
             for l in gqa_layers:
                 caches[l.name] = (
-                    jnp.zeros(
-                        (b, maxlen, l.num_key_value_heads, l.head_dim),
-                        jnp.float32,
+                    _constrain_cache(
+                        jnp.zeros(
+                            (b, maxlen, l.num_key_value_heads, l.head_dim),
+                            jnp.float32,
+                        ),
+                        l.num_key_value_heads,
                     ),
-                    jnp.zeros(
-                        (b, maxlen, l.num_key_value_heads, l.head_dim),
-                        jnp.float32,
+                    _constrain_cache(
+                        jnp.zeros(
+                            (b, maxlen, l.num_key_value_heads, l.head_dim),
+                            jnp.float32,
+                        ),
+                        l.num_key_value_heads,
                     ),
                 )
 
@@ -840,5 +1023,7 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
 
         cache[cache_key] = run
 
-    out = run(weights, jnp.asarray(tokens0), jax.random.PRNGKey(seed))
-    return np.asarray(out[:, :total])
+    return _finish_decode(
+        model, run, (weights,), tokens0, jax.random.PRNGKey(seed),
+        mesh, batch_axes, b if n_real is None else n_real, total,
+    )

@@ -673,6 +673,68 @@ class SparkModel:
             ordered = [results.pop("loss")] + list(results.values())
         return ordered if len(ordered) > 1 else ordered[0]
 
+    def generate(
+        self,
+        prompt,
+        steps: int,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        seed: int = 0,
+        kv_cache: bool = False,
+    ):
+        """Distributed autoregressive generation on the wrapper's mesh —
+        the LM analogue of :meth:`predict` (the reference's inference is
+        distributed too: ``[U] elephas/spark_model.py::predict``,
+        SURVEY.md §3.4).
+
+        The decode loop runs as ONE GSPMD program over the SAME mesh
+        this wrapper trains on, so a model that only fits sharded can
+        also decode:
+
+        - data / seq / workers axes fan the batch out (prompts pad up to
+          the axis product and the padding is sliced off);
+        - ``model_parallel``: weights stay sharded through the decode
+          loop under the TP planner's layouts, and with
+          ``kv_cache=True`` the per-layer K/V caches shard with the
+          head axis;
+        - ``pipeline_parallel``: decode is depth-replicated — the stage
+          axis joins the batch axes instead (pipeline stages exist for
+          training-time memory; a stage-ring decode is not implemented).
+
+        Every gang process must make the identical call (SPMD
+        contract); all return the full ``[B, P+steps]`` tokens.
+        """
+        from elephas_tpu.models.transformer import generate as _generate
+
+        if self.pipeline_parallel > 1:
+            # dp=1 builds a 1-D ('stages',) mesh — only fan over the
+            # axes that exist (code-review r5)
+            batch_axes = tuple(
+                a for a in ("data", "stages") if a in self.mesh.shape
+            )
+            model_axis = None
+        elif self.sequence_parallel > 1:
+            batch_axes = ("data", "seq")
+            model_axis = "model" if self.model_parallel > 1 else None
+        elif self.model_parallel > 1:
+            batch_axes, model_axis = ("data",), "model"
+        else:
+            batch_axes, model_axis = ("workers",), None
+        return _generate(
+            self._master_network,
+            prompt,
+            steps,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            seed=seed,
+            kv_cache=kv_cache,
+            mesh=self.mesh,
+            batch_axes=batch_axes,
+            model_axis=model_axis,
+        )
+
     # -- persistence ---------------------------------------------------
 
     def save(self, file_name: str) -> None:

@@ -1,0 +1,153 @@
+"""Mesh-aware generate() — distributed decode (VERDICT r4 #1).
+
+The reference's inference surface is distributed (``[U]
+elephas/spark_model.py::predict``, SURVEY.md §3.4); ``generate`` is the
+LM analogue and must run under the same meshes training does: batch
+fans over the data axes, TP keeps weights (and KV caches) sharded
+through the decode loop. Every test checks EXACT greedy-token parity
+with the single-device path plus that the program really ran
+batch-sharded (the out-sharding introspection hook).
+"""
+
+import numpy as np
+import pytest
+
+
+def _batch_axes_of(model):
+    sh = model._elephas_generate_out_sharding
+    s = sh.spec[0] if len(sh.spec) else None
+    if s is None:
+        return ()
+    return s if isinstance(s, tuple) else (s,)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """A small trained LM (periodic sequences) — training sharpens the
+    logits so greedy parity across shardings is not a coin flip."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import transformer_lm
+
+    maxlen, vocab, n = 16, 8, 256
+    rng = np.random.default_rng(0)
+    starts = rng.integers(2, 6, size=n)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+    m = transformer_lm(
+        vocab_size=vocab, maxlen=maxlen, d_model=32, num_heads=2,
+        num_layers=2, dropout=0.0, lr=1e-2, seed=0,
+    )
+    SparkModel(m, num_workers=4).fit((x, y), epochs=4, batch_size=32)
+    return m
+
+
+PROMPT = np.array([[2, 3, 4, 5], [4, 5, 2, 3]], np.int32)
+
+
+def test_tp_generate_matches_single_device(lm):
+    """model_parallel=2: weights decode SHARDED (TP planner layouts)
+    and the greedy tokens match the single-device path exactly; the
+    batch rode the data axis (b=2 pads up to dp=4 and slices back)."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate
+
+    ref = generate(lm, PROMPT, steps=8)
+    sm = SparkModel(lm, model_parallel=2)
+    out = sm.generate(PROMPT, steps=8)
+    np.testing.assert_array_equal(out, ref)
+    assert _batch_axes_of(lm) == ("data",)
+
+
+def test_tp_generate_kv_cache_matches(lm):
+    """TP decode with the KV cache: same tokens, caches head-sharded."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate
+
+    ref = generate(lm, PROMPT, steps=8)
+    sm = SparkModel(lm, model_parallel=2)
+    out = sm.generate(PROMPT, steps=8, kv_cache=True)
+    np.testing.assert_array_equal(out, ref)
+    assert _batch_axes_of(lm) == ("data",)
+
+
+def test_dp_generate_batch_split(lm):
+    """Pure DP: the batch splits across the workers axis (odd batch of
+    3 pads to the 4-worker mesh) and tokens match single-device."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate
+
+    prompt = np.concatenate([PROMPT, PROMPT[:1]])  # b=3
+    ref = generate(lm, prompt, steps=8)
+    sm = SparkModel(lm, num_workers=4)
+    out = sm.generate(prompt, steps=8)
+    np.testing.assert_array_equal(out, ref)
+    assert _batch_axes_of(lm) == ("workers",)
+
+
+def test_sp_generate_uses_both_axes(lm):
+    """sequence_parallel: decode is token-at-a-time, so the seq axis
+    joins the batch fan-out instead of idling."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate
+
+    ref = generate(lm, PROMPT, steps=8)
+    sm = SparkModel(lm, sequence_parallel=2)
+    out = sm.generate(PROMPT, steps=8)
+    np.testing.assert_array_equal(out, ref)
+    assert set(_batch_axes_of(lm)) == {"data", "seq"}
+
+
+def test_pp_generate_depth_replicated(lm):
+    """pipeline_parallel: decode replicates depth and fans the batch
+    over (data, stages) — documented fallback, exact tokens."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate
+
+    ref = generate(lm, PROMPT, steps=8)
+    sm = SparkModel(lm, pipeline_parallel=2, num_workers=2)
+    out = sm.generate(PROMPT, steps=8)
+    np.testing.assert_array_equal(out, ref)
+    assert set(_batch_axes_of(lm)) == {"data", "stages"}
+
+
+def test_pp_generate_default_workers_1d_mesh(lm):
+    """pipeline_parallel with the DEFAULT num_workers builds a 1-D
+    ('stages',) mesh — generate must fan over the axes that exist
+    (code-review r5: hardcoded ('data','stages') raised here)."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate
+
+    ref = generate(lm, PROMPT, steps=8)
+    sm = SparkModel(lm, pipeline_parallel=2)
+    assert tuple(sm.mesh.shape) == ("stages",), sm.mesh.shape
+    out = sm.generate(PROMPT, steps=8)
+    np.testing.assert_array_equal(out, ref)
+    assert _batch_axes_of(lm) == ("stages",)
+
+
+def test_tp_sampled_generate_deterministic_and_valid(lm):
+    """Sampled decode on the mesh: in-vocab, prompt kept, and the same
+    seed reproduces (partitionable threefry keeps the stream stable
+    under sharding)."""
+    from elephas_tpu import SparkModel
+
+    sm = SparkModel(lm, model_parallel=2)
+    s1 = sm.generate(PROMPT, steps=8, temperature=0.8, top_k=3, seed=1)
+    s2 = sm.generate(PROMPT, steps=8, temperature=0.8, top_k=3, seed=1)
+    np.testing.assert_array_equal(s1, s2)
+    assert s1.shape == (2, 12)
+    assert s1.min() >= 0 and s1.max() < 8
+    np.testing.assert_array_equal(s1[:, :4], PROMPT)
+
+
+def test_tpsp_generate_composes(lm):
+    """TP×SP 3-D mesh: weights shard over model, batch over
+    data×seq."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate
+
+    ref = generate(lm, PROMPT, steps=8)
+    sm = SparkModel(lm, sequence_parallel=2, model_parallel=2)
+    out = sm.generate(PROMPT, steps=8)
+    np.testing.assert_array_equal(out, ref)
+    assert set(_batch_axes_of(lm)) == {"data", "seq"}
