@@ -442,21 +442,29 @@ class GPipeTrainer:
             check_vma=False,
         )
 
-    def _build_train_step(self, collect_outputs: bool = False):
-        forward = self._forward(collect_outputs=collect_outputs)
+    def _build_train_step(self, metric_update=None, mvs_example=None):
+        """The jitted pipeline train step. With ``metric_update``, keras
+        metric states accumulate INSIDE the compiled step on the last
+        stage's predictions (r5, VERDICT r4 #5 — the r4 design returned
+        per-step predictions as a gradient aux and updated metric states
+        host-side: an O(dataset × output_dim) device→host transfer per
+        epoch; now only the tiny metric-state pytree leaves the device,
+        once per epoch)."""
+        forward = self._forward(collect_outputs=metric_update is not None)
         optimizer = self.optimizer
+        collect = metric_update is not None
 
         def loss_of(params, state, xm, ym):
             loss, outs, new_state = forward(params, state, xm, ym)
-            # only the LAST stage's slice leaves the jit as the metrics
-            # aux — shipping the stage-sharded [S, M, ·] buffer would
-            # gather S× the needed bytes per batch; when not collecting,
-            # nothing leaves and XLA DCEs the scan's outputs carry
-            # entirely (code-review r4)
-            aux = outs[self.S - 1] if collect_outputs else ()
+            # only the LAST stage's slice feeds the metric math —
+            # reading the full stage-sharded [S, M, ·] buffer would
+            # gather S× the needed bytes; when not collecting, nothing
+            # is read and XLA DCEs the scan's outputs carry entirely
+            # (code-review r4)
+            aux = outs[self.S - 1] if collect else ()
             return loss, (new_state, aux)
 
-        def step(params, state, opt_state, xm, ym):
+        def base_step(params, state, opt_state, xm, ym):
             (loss, (new_state, outs)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(params, state, xm, ym)
@@ -467,20 +475,44 @@ class GPipeTrainer:
             return params, new_state, opt_state, loss, outs
 
         state_sh = jax.tree.map(lambda l: l.sharding, self.opt_state)
-        aux_sh = (
-            NamedSharding(
-                self.mesh,
-                P(None, self.data_axis) if self.dp > 1 else P(),
+        in_sh = (self._stage_sh, self._stage_sh, state_sh,
+                 self._mb_sh, self._mb_sh)
+        out_sh = (self._stage_sh, self._stage_sh, state_sh, self._rep_sh)
+
+        if not collect:
+
+            def step(params, state, opt_state, xm, ym):
+                p, st, opt, loss, _ = base_step(params, state, opt_state,
+                                                xm, ym)
+                return p, st, opt, loss
+
+            return jax.jit(
+                step, in_shardings=in_sh, out_shardings=out_sh,
+                donate_argnums=(0, 1, 2),
             )
-            if collect_outputs
-            else ()
-        )
+
+        mvs_rep = jax.tree.map(lambda _: self._rep_sh, mvs_example)
+
+        def step(params, state, opt_state, xm, ym, mvs, sw):
+            p, st, opt, loss, outs = base_step(params, state, opt_state,
+                                               xm, ym)
+            # [M, dp·elems] → [batch, ...] rows in input order (replica
+            # r's rows are the r-th contiguous chunk of each
+            # microbatch); ym flattens identically, so rows align. All
+            # inside the jit — no host round-trip.
+            out_tail = tuple(self._shapes[-1].shape[1:])
+            batch = self.M * self.mb_rows * self.dp
+            y_pred_rows = outs.reshape(
+                (self.M, self.dp, self.mb_rows) + out_tail
+            ).reshape((batch,) + out_tail)
+            y_rows = ym.reshape((batch,) + tuple(ym.shape[2:]))
+            mvs = metric_update(mvs, y_rows, y_pred_rows, sw.reshape(batch))
+            return p, st, opt, loss, mvs
+
         return jax.jit(
             step,
-            in_shardings=(self._stage_sh, self._stage_sh, state_sh,
-                          self._mb_sh, self._mb_sh),
-            out_shardings=(self._stage_sh, self._stage_sh, state_sh,
-                           self._rep_sh, aux_sh),
+            in_shardings=in_sh + (mvs_rep, self._mb_sh),
+            out_shardings=out_sh + (mvs_rep,),
             donate_argnums=(0, 1, 2),
         )
 
@@ -495,23 +527,30 @@ class GPipeTrainer:
     # -- API -------------------------------------------------------------
 
     def fit(self, x, y, epochs: int = 1, batch_size: int = 32, verbose: int = 0,
-            callbacks=None, on_batch_outputs=None):
+            callbacks=None, metric_state=None, metric_update=None,
+            on_epoch_metrics=None):
         """Mini-batch training; returns ``{'loss': [...]}`` per epoch.
         ``callbacks`` are ``cb(epoch, loss)`` at epoch boundaries.
-        ``on_batch_outputs(y_pred, rows, valid)`` (r4), when given,
-        receives the last stage's predictions for every training batch
-        (gathered to host) plus a boolean mask that is False on
-        wrap-padded duplicate rows — the hook the runner uses to
-        accumulate keras training metrics (zero-weighting the pads)
-        without putting metric updates on the ring's critical path.
+
+        Compiled training metrics (r5, VERDICT r4 #5): pass
+        ``metric_state`` (an initial state pytree),
+        ``metric_update(mvs, y_rows, y_pred_rows, sw_rows) -> mvs``
+        (traced INTO the jitted step — it sees the last stage's
+        predictions on device, wrap-padded duplicate rows zero-weighted
+        via ``sw_rows``), and ``on_epoch_metrics(mvs_host)`` (called at
+        each epoch boundary, BEFORE ``callbacks``, with the host-read
+        accumulated state, after which the state resets). Only the tiny
+        state pytree crosses to host, once per epoch — predictions
+        never do.
 
         ``batch_size`` is rounded up to a multiple of ``M`` (each
         microbatch keeps a fixed shape); the final short batch wrap-pads
-        rows at full weight — duplicated rows slightly overweight, the
-        same semantics as the DP runner's staged
+        rows at full weight for the LOSS — duplicated rows slightly
+        overweight, the same semantics as the DP runner's staged
         :func:`~elephas_tpu.worker.pad_to_batches` (the masked-tail
         exactness of :class:`~elephas_tpu.parallel.tensor.ShardedTrainer`
-        would need weight-aware user loss_fns here).
+        would need weight-aware user loss_fns here). Metrics DO
+        zero-weight the pads, like keras.
         """
         x = np.asarray(x)
         y = np.asarray(y)
@@ -527,68 +566,75 @@ class GPipeTrainer:
         batch_size = self.M * self.mb_rows * self.dp
         nb = max(1, int(np.ceil(n / batch_size)))
         idx = np.arange(nb * batch_size) % n
-        collect = on_batch_outputs is not None
-        train_step = self._get_train_step(collect)
-
-        def drain(pending):
-            outs_, rows_, valid_ = pending
-            on_batch_outputs(
-                self._outputs_to_host(outs_, batch_size), rows_, valid_
+        collect = metric_update is not None
+        train_step = self._get_train_step(metric_update, metric_state)
+        mvs = None
+        if collect:
+            mvs = jax.tree.map(
+                lambda l: put_global(np.asarray(l), self._rep_sh),
+                metric_state,
             )
 
         history = {"loss": []}
         for epoch in range(epochs):
             losses = []
-            pending = None  # previous batch's aux: host-read ONE batch
-            # behind dispatch, so the metric gather/update overlaps the
-            # next step's device compute instead of serializing the
-            # dispatch loop (code-review r4)
             for b in range(nb):
                 rows = idx[b * batch_size : (b + 1) * batch_size]
                 xm = self._microbatches(x[rows], batch_size)
                 ym = np.asarray(y[rows]).reshape(
                     (M, batch_size // M) + y.shape[1:]
                 )
-                self.params, self.state, self.opt_state, loss, outs = (
-                    train_step(
-                        self.params, self.state, self.opt_state,
-                        put_global(xm, self._mb_sh),
-                        put_global(ym, self._mb_sh),
-                    )
+                args = (
+                    self.params, self.state, self.opt_state,
+                    put_global(xm, self._mb_sh),
+                    put_global(ym, self._mb_sh),
                 )
-                losses.append(loss)
                 if collect:
-                    if pending is not None:
-                        drain(pending)
                     valid = (
-                        b * batch_size + np.arange(batch_size)
-                    ) < n
-                    pending = (outs, rows, valid)
-            if collect and pending is not None:
-                drain(pending)
+                        (b * batch_size + np.arange(batch_size)) < n
+                    ).astype(np.float32).reshape(M, batch_size // M)
+                    (self.params, self.state, self.opt_state, loss,
+                     mvs) = train_step(
+                        *args, mvs, put_global(valid, self._mb_sh)
+                    )
+                else:
+                    self.params, self.state, self.opt_state, loss = (
+                        train_step(*args)
+                    )
+                losses.append(loss)
+            if collect:
+                mvs = self._drain_metrics(
+                    mvs, metric_state, on_epoch_metrics
+                )
             self._finish_epoch(
                 history, losses, epoch, epochs, verbose, callbacks
             )
         return history
 
-    def _get_train_step(self, collect_outputs: bool):
-        """Get-or-build the jitted step, cached per collect flag."""
-        step = self._train_steps.get(collect_outputs)
-        if step is None:
-            step = self._train_steps[collect_outputs] = (
-                self._build_train_step(collect_outputs)
-            )
+    def _get_train_step(self, metric_update=None, metric_state=None):
+        """Get-or-build the jitted step, cached per metrics-or-not. The
+        cache pins the exact ``metric_update`` closure it traced — a
+        DIFFERENT closure (or state pytree) on a later fit rebuilds
+        instead of silently serving the stale traced math
+        (code-review r5)."""
+        key = metric_update is not None
+        cached = self._train_steps.get(key)
+        if cached is not None and cached[1] is metric_update:
+            return cached[0]
+        step = self._build_train_step(metric_update, metric_state)
+        self._train_steps[key] = (step, metric_update)
         return step
 
-    def _outputs_to_host(self, outs, batch_size) -> np.ndarray:
-        """Last stage's predictions ``[M, dp·elems]`` → host
-        ``[batch, ...]`` rows in input order (replica ``r``'s rows are
-        the r-th contiguous chunk of each microbatch)."""
-        out_shape = self._shapes[-1].shape
-        res = host_read(outs, self.mesh)
-        return np.asarray(
-            res.reshape((self.M, self.dp, self.mb_rows) + out_shape[1:])
-            .reshape((batch_size,) + out_shape[1:])
+    def _drain_metrics(self, mvs, metric_state, on_epoch_metrics):
+        """Epoch-boundary metric handoff shared by the staged and
+        streamed fits: host-read the accumulated state, hand it to the
+        caller, reset to the initial state on device."""
+        on_epoch_metrics(
+            jax.tree.map(lambda l: host_read(l, self.mesh), mvs)
+        )
+        return jax.tree.map(
+            lambda l: put_global(np.asarray(l), self._rep_sh),
+            metric_state,
         )
 
     def _finish_epoch(self, history, losses, epoch, epochs, verbose,
@@ -607,7 +653,8 @@ class GPipeTrainer:
         return epoch_loss
 
     def fit_stream(self, stream, epochs: int = 1, verbose: int = 0,
-                   callbacks=None):
+                   callbacks=None, metric_state=None, metric_update=None,
+                   on_epoch_metrics=None):
         """Streamed training over :class:`ShardedStream` blocks shaped
         ``[dp, steps, B, ...]`` — each step's global batch is the
         ``dp`` row-shards concatenated (``dp·B`` rows), microbatched
@@ -619,6 +666,13 @@ class GPipeTrainer:
         microbatches — every step then carries the exact compiled shape
         with no mid-epoch padding (the stream wrap-pads short shard
         tails internally, matching the staged path's tail semantics).
+
+        Compiled training metrics (r5, VERDICT r4 #7): same
+        ``metric_state`` / ``metric_update`` / ``on_epoch_metrics``
+        contract as :meth:`fit` — states accumulate on device through
+        every streamed block and cross to host once per epoch.
+        Stream-internal wrap-pad rows count at full weight, like the
+        streamed loss.
         """
         from elephas_tpu.data.streaming import prefetch_blocks
 
@@ -648,7 +702,21 @@ class GPipeTrainer:
                 f"the compiled pipeline takes {need} — match the stream "
                 f"batch_size to the fit batch_size"
             )
-        train_step = self._get_train_step(False)
+        collect = metric_update is not None
+        train_step = self._get_train_step(metric_update, metric_state)
+        mvs = None
+        sw_dev = None
+        if collect:
+            mvs = jax.tree.map(
+                lambda l: put_global(np.asarray(l), self._rep_sh),
+                metric_state,
+            )
+            # streamed rows all count (the stream wrap-pads internally,
+            # like the loss); ONE device-resident all-ones weight buffer
+            # serves every step — no per-step upload (code-review r5)
+            sw_dev = put_global(
+                np.ones((M, need // M), np.float32), self._mb_sh
+            )
 
         history: dict[str, list[float]] = {"loss": []}
         for epoch in range(epochs):
@@ -662,14 +730,22 @@ class GPipeTrainer:
                     )
                     xm = self._microbatches(x_flat, need)
                     ym = y_flat.reshape((M, need // M) + y_flat.shape[1:])
-                    self.params, self.state, self.opt_state, loss, _ = (
-                        train_step(
-                            self.params, self.state, self.opt_state,
-                            put_global(xm, self._mb_sh),
-                            put_global(ym, self._mb_sh),
-                        )
+                    args = (
+                        self.params, self.state, self.opt_state,
+                        put_global(xm, self._mb_sh),
+                        put_global(ym, self._mb_sh),
                     )
+                    if collect:
+                        (self.params, self.state, self.opt_state, loss,
+                         mvs) = train_step(*args, mvs, sw_dev)
+                    else:
+                        (self.params, self.state, self.opt_state,
+                         loss) = train_step(*args)
                     losses.append(loss)
+            if collect:
+                mvs = self._drain_metrics(
+                    mvs, metric_state, on_epoch_metrics
+                )
             self._finish_epoch(
                 history, losses, epoch, epochs, verbose, callbacks
             )

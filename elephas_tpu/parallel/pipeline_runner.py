@@ -677,8 +677,6 @@ class PipelineRunner:
         return self._eval_helpers
 
     def run_epochs(self, partitions, epochs, batch_size, verbose=0, callbacks=None):
-        import jax.numpy as jnp
-
         if len(partitions) == 1:
             # the pipeline consumes whole batches; avoid a second full
             # host copy of a possibly multi-GB dataset
@@ -687,76 +685,95 @@ class PipelineRunner:
             x = np.concatenate([np.asarray(p[0]) for p in partitions])
             y = np.concatenate([np.asarray(p[1]) for p in partitions])
 
-        # r4 (closes the r3 loss-only restriction): the train step
-        # collects the last stage's predictions as a gradient aux, and
-        # the compiled-metrics machinery accumulates keras training
-        # metrics ON HOST from them — nothing lands on the ring's
-        # critical path. Same accumulate-over-epoch-then-reset
-        # semantics as keras fit.
-        on_batch = None
-        metric_objects = []
-        intro = None
-        # only models with COMPILED metrics pay the helper build (whose
-        # metric-object creation runs a one-row master-model forward on
-        # one device — unaffordable exactly when the model is pipelined
-        # because it doesn't fit one device, so degrade to loss-only
-        # with a warning rather than OOM; code-review r4)
-        if getattr(self.model, "_compile_metrics", None) is not None:
+        # r5 (VERDICT r4 #5, supersedes the r4 host-side design): keras
+        # metric states accumulate INSIDE the compiled pipeline step on
+        # the last stage's predictions and cross to host once per epoch
+        # — the per-step O(batch × output_dim) predictions-to-host aux
+        # transfer is gone. Same accumulate-over-epoch-then-reset
+        # semantics as keras fit; wrap-padded rows carry zero weight.
+        metric_kwargs, tails = self._metric_kwargs(x[:1], y[:1])
+        history = self.trainer.fit(
+            x, y, epochs=epochs, batch_size=batch_size, verbose=verbose,
+            callbacks=self._wrap_callbacks(callbacks), **metric_kwargs,
+        )
+        self._merge_tails(history, tails)
+        self._write_back()
+        return history
+
+    @staticmethod
+    def _merge_tails(history, tails):
+        for key in tails[0] if tails else ():
+            history[key] = [t[key] for t in tails]
+
+    def _metric_kwargs(self, x1, y1):
+        """(trainer metric kwargs, tails list) for compiled training
+        metrics — shared by the staged and streamed fits.
+
+        Only models with COMPILED metrics pay the helper build (whose
+        metric-object creation runs a one-row master-model forward on
+        one device — unaffordable exactly when the model is pipelined
+        because it doesn't fit one device, so degrade to loss-only
+        with a warning rather than OOM; code-review r4)."""
+        tails: list[dict] = []
+        if getattr(self.model, "_compile_metrics", None) is None:
+            return {}, tails
+        machinery = getattr(self, "_metric_machinery", None)
+        if machinery is None:
             try:
-                intro, _per_sample, metric_objects = self._helpers(
-                    x[:1], y[:1]
-                )
+                intro, _per_sample, metric_objects = self._helpers(x1, y1)
             except Exception as exc:
                 logger.warning(
                     "pipeline_parallel: could not build the training-"
                     "metric machinery (%s) — history will be loss-only",
                     exc,
                 )
-                metric_objects = []
-        tails: list[dict] = []
-        if metric_objects:
-            mvs_box = {"mvs": intro._zero_metric_state(metric_objects)}
+                self._metric_machinery = ()
+                return {}, tails
+            if not metric_objects:
+                self._metric_machinery = ()
+                return {}, tails
 
-            def on_batch(y_pred, rows, valid):
-                yb = jnp.asarray(y[rows])
-                yp = jnp.asarray(y_pred)
-                # wrap-padded duplicate rows carry zero weight so each
-                # real row counts exactly once per epoch, like keras
-                sw = jnp.asarray(valid, jnp.float32)
-                mvs_box["mvs"] = [
-                    m.stateless_update_state(mv, yb, yp, sw)
-                    for (m, _i, _n), mv in zip(
-                        metric_objects, mvs_box["mvs"]
+            def metric_update(mvs, y_rows, y_pred_rows, sw_rows):
+                return [
+                    m.stateless_update_state(
+                        mv, y_rows, y_pred_rows, sw_rows
                     )
+                    for (m, _i, _n), mv in zip(metric_objects, mvs)
                 ]
 
-            def metric_epoch_cb(epoch, loss):
-                tail: dict[str, list[float]] = {}
-                intro._history_from_metrics(
-                    tail, metric_objects, mvs_box["mvs"]
-                )
-                tails.append({k: v[0] for k, v in tail.items()})
-                mvs_box["mvs"] = intro._zero_metric_state(metric_objects)
+            # cached on the runner so repeat fits hand the trainer the
+            # SAME closure — its compiled-step cache is keyed on closure
+            # identity (code-review r5)
+            machinery = self._metric_machinery = (
+                intro, metric_objects, metric_update,
+            )
+        if not machinery:
+            return {}, tails
+        intro, metric_objects, metric_update = machinery
 
-        extra_cbs = self._wrap_callbacks(callbacks) or []
-        if metric_objects:
-            # metric finalization runs FIRST so user callbacks (per-epoch
-            # validation appends val_* after train metrics) keep order
-            extra_cbs = [metric_epoch_cb] + extra_cbs
-        history = self.trainer.fit(
-            x, y, epochs=epochs, batch_size=batch_size, verbose=verbose,
-            callbacks=extra_cbs or None, on_batch_outputs=on_batch,
-        )
-        for key in tails[0] if tails else ():
-            history[key] = [t[key] for t in tails]
-        self._write_back()
-        return history
+        def on_epoch_metrics(mvs_host):
+            tail: dict[str, list[float]] = {}
+            intro._history_from_metrics(tail, metric_objects, mvs_host)
+            tails.append({k: v[0] for k, v in tail.items()})
+
+        return {
+            "metric_state": intro._zero_metric_state(metric_objects),
+            "metric_update": metric_update,
+            "on_epoch_metrics": on_epoch_metrics,
+        }, tails
 
     def run_epochs_stream(self, stream, epochs, verbose=0, callbacks=None):
+        # r5 (VERDICT r4 #7): the streamed fit reports the same compiled
+        # training metrics as the staged one — states ride the device
+        # through every block, host-read once per epoch
+        x1 = np.asarray(stream.x[0:1])
+        y1 = np.asarray(stream.y[0:1])
+        metric_kwargs, tails = self._metric_kwargs(x1, y1)
         history = self.trainer.fit_stream(
             stream, epochs, verbose=verbose,
-            callbacks=self._wrap_callbacks(callbacks),
+            callbacks=self._wrap_callbacks(callbacks), **metric_kwargs,
         )
+        self._merge_tails(history, tails)
         self._write_back()
         return history
 

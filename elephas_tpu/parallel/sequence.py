@@ -204,15 +204,24 @@ def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
         head_axis = scope.data_axis
     else:
         head_axis = None
-    absorbed = head_axis is not None and scope.data_axis in (
-        head_axis if isinstance(head_axis, tuple) else (head_axis,)
+    head_axes = (
+        head_axis if isinstance(head_axis, tuple)
+        else () if head_axis is None else (head_axis,)
     )
-    if data_axis is None and dp > 1 and not absorbed:
+    if data_axis is None and dp > 1 and scope.data_axis not in head_axes:
         logger.info(
             "ring: neither batch %d nor heads %d tile over data=%d — "
             "activations replicate across the data axis for this call "
             "(correct, but a multi-x memory/throughput cost)",
             b, h, dp,
+        )
+    if mp > 1 and mp_axis not in head_axes:
+        logger.info(
+            "ring: heads %d do not tile over model=%d — attention "
+            "activations replicate across the model axis for this call "
+            "(correct, but model_parallel buys no attention speedup "
+            "here)",
+            h, mp,
         )
     spec = P(data_axis, head_axis, scope.seq_axis, None)
 

@@ -733,6 +733,54 @@ def test_spark_model_pipeline_streams_memmap(tmp_path, blobs):
     assert acc > 0.85, acc
 
 
+def test_pp_training_metrics_stay_on_device(blobs, monkeypatch):
+    """r5 (VERDICT r4 #5): metric states accumulate INSIDE the compiled
+    pipeline step — predictions never cross to host per step. The
+    host-transfer count (host_read calls) must be independent of the
+    number of batches: doubling the dataset must not add transfers."""
+    from elephas_tpu import SparkModel
+
+    import elephas_tpu.ops.pipeline as pl
+
+    x, y, d, k = blobs
+    calls = {"n": 0}
+    real = pl.host_read
+
+    def counting(leaf, mesh):
+        calls["n"] += 1
+        return real(leaf, mesh)
+
+    monkeypatch.setattr(pl, "host_read", counting)
+
+    sm = SparkModel(_pp_mlp(d, k, seed=91), pipeline_parallel=2)
+    h1 = sm.fit((x[:256], y[:256]), epochs=2, batch_size=32)  # 8 b/epoch
+    assert "accuracy" in h1
+    few = calls["n"]
+    calls["n"] = 0
+    sm2 = SparkModel(_pp_mlp(d, k, seed=91), pipeline_parallel=2)
+    h2 = sm2.fit((x[:512], y[:512]), epochs=2, batch_size=32)  # 16 b/epoch
+    assert "accuracy" in h2
+    assert calls["n"] == few, (few, calls["n"])
+
+
+def test_pp_stream_fit_reports_metrics(blobs):
+    """r5 (VERDICT r4 #7): the STREAMED pipeline fit reports the same
+    compiled training metrics as the staged one — loss-only no more."""
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    n = 512
+    sm = SparkModel(_pp_mlp(d, k, seed=17), pipeline_parallel=2,
+                    num_workers=2)
+    history = sm.fit((x[:n], y[:n]), epochs=4, batch_size=32,
+                     stream_block_steps=2)
+    assert "accuracy" in history and len(history["accuracy"]) == 4, (
+        history.keys()
+    )
+    assert history["accuracy"][-1] > 0.8, history["accuracy"]
+    assert history["accuracy"][-1] > history["accuracy"][0], history
+
+
 def test_gpipe_fit_stream_guards():
     """Stream batch must divide into the microbatches (no silent
     per-step pad bias) and match the compiled pipeline's global batch."""

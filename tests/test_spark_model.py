@@ -383,6 +383,29 @@ def test_evaluate_order_pinned_to_metrics_names(spark_context, blobs):
     np.testing.assert_allclose(dist, ref, atol=1e-3)
 
 
+def test_evaluate_warns_on_metrics_names_fallback(blobs, caplog, monkeypatch):
+    """r5 (VERDICT r4 #8): when metrics_names doesn't match the computed
+    result keys, the insertion-order fallback engages with a WARNING
+    naming both sets (silent before — one keras bump from mislabeled
+    metrics)."""
+    import logging
+
+    x, y, d, k = blobs
+    sm = SparkModel(make_mlp(d, k, seed=61), num_workers=4)
+    # force a mismatching metrics_names view (it is a read-only keras
+    # property — patch it at the class level, restored by monkeypatch)
+    monkeypatch.setattr(
+        type(sm._master_network), "metrics_names",
+        property(lambda self: ["loss", "not_a_real_metric"]),
+    )
+    with caplog.at_level(logging.WARNING, logger="elephas_tpu.spark_model"):
+        scores = sm.evaluate(x[:64], y[:64], batch_size=32)
+    assert len(scores) == 2 and all(np.isfinite(s) for s in scores)
+    warn = [r for r in caplog.records if "metrics_names" in r.getMessage()]
+    assert warn, caplog.records
+    assert "not_a_real_metric" in warn[0].getMessage()
+
+
 def test_history_log_jsonl(tmp_path, spark_context, blobs):
     """r3: epoch-level metrics export (SURVEY §5 lists none upstream) —
     one live JSONL line per epoch plus a final full-history line."""

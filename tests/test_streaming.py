@@ -138,6 +138,26 @@ def test_validation_split_keeps_train_split_lazy(blobs):
     assert history["loss"][-1] < history["loss"][0]
 
 
+def test_validation_tail_streams_in_blocks(blobs):
+    """r5 (VERDICT r4 #7): the validation TAIL is evaluated block-by-
+    block too — the largest single materialization is one block, even
+    when the held-out span is bigger than a block (the r4 design staged
+    the whole tail eagerly)."""
+    x, y, d, k = blobs
+    xs, ys = _EagerSource(x), _EagerSource(y)
+    sm = SparkModel(make_mlp(d, k, seed=23), num_workers=8)
+    history = sm.fit(
+        (xs, ys), epochs=2, batch_size=32, validation_split=0.2,
+        stream_block_steps=1,
+    )
+    n_val = int(len(x) * 0.2)  # 320 held-out rows
+    val_block = 1 * 32 * 8  # block_steps × batch × workers = 256
+    assert val_block < n_val  # the tail truly spans multiple blocks
+    assert len(history["val_loss"]) == 2
+    assert xs.max_rows <= val_block, xs.max_rows
+    assert np.isfinite(history["val_loss"][-1])
+
+
 class _StrictSource(_EagerSource):
     """h5py-faithful: point selection requires strictly increasing,
     duplicate-free index arrays."""
