@@ -105,21 +105,33 @@ class SparkModel:
             )
             if n > 1
         ]
-        # model_parallel × sequence_parallel compose (3-D
-        # ('data','seq','model') mesh); the pipeline stays exclusive
-        if len(active) > 1 and "pipeline_parallel" in active:
+        # model_parallel composes with sequence_parallel (3-D
+        # ('data','seq','model') mesh) AND with pipeline_parallel (r5:
+        # ('data','stages','model') — stage weights width-shard inside
+        # each ring position); pipeline × sequence stays exclusive
+        if (
+            "pipeline_parallel" in active
+            and "sequence_parallel" in active
+        ):
             raise ValueError(
-                f"{' and '.join(active)} cannot compose — the pipeline "
-                f"is depth-exclusive (model/sequence parallelism and "
-                f"data parallelism compose freely)"
+                "pipeline_parallel and sequence_parallel cannot compose "
+                "— shard depth (stages) with model_parallel instead, or "
+                "drop one of the two"
             )
         if self.pipeline_parallel > 1:
             import jax
 
-            if self.pipeline_parallel > len(jax.devices()):
+            need = self.pipeline_parallel * self.model_parallel
+            if need > len(jax.devices()):
                 raise ValueError(
-                    f"pipeline_parallel={pipeline_parallel} exceeds the "
-                    f"{len(jax.devices())} available devices"
+                    f"pipeline_parallel={pipeline_parallel}"
+                    + (
+                        f" × model_parallel={model_parallel}"
+                        if self.model_parallel > 1
+                        else ""
+                    )
+                    + f" exceeds the {len(jax.devices())} available "
+                    f"devices"
                 )
             if self.mode != "synchronous":
                 raise ValueError(
@@ -129,13 +141,15 @@ class SparkModel:
                 )
             from elephas_tpu.ops.pipeline import pipeline_mesh
 
-            # DP×PP: num_workers asks for data replicas AROUND the
-            # pipeline — a ('data','stages') mesh where each data row
-            # runs its own activation ring (capped to the device budget,
-            # like the TP/SP branches)
-            max_dp = max(1, len(jax.devices()) // self.pipeline_parallel)
+            # DP×PP(×TP): num_workers asks for data replicas AROUND the
+            # pipeline — each data row runs its own activation ring
+            # (capped to the device budget, like the TP/SP branches)
+            max_dp = max(1, len(jax.devices()) // need)
             dp = min(num_workers, max_dp) if num_workers else 1
-            self.mesh = pipeline_mesh(self.pipeline_parallel, dp)
+            self.mesh = pipeline_mesh(
+                self.pipeline_parallel, dp,
+                model_parallel=self.model_parallel,
+            )
             self.num_workers = dp
             self._runner = None
             self._parameter_server = None
@@ -656,6 +670,31 @@ class SparkModel:
             return None
         x, y, n, n_val = val_spec
         block = max(1, int(val_block or n_val))
+        if block < n_val:
+            # surface the blockwise approximation for metrics that are
+            # NOT row-weighted means (code-review r5): AUC-class state
+            # does not average across blocks
+            import keras
+
+            mean_like = (keras.metrics.Mean, keras.metrics.MeanMetricWrapper)
+            flat = []
+            for m in getattr(self._master_network, "metrics", []):
+                flat.extend(getattr(m, "metrics", None) or [m])
+            stateful = [
+                m.name
+                for m in flat
+                if isinstance(m, keras.metrics.Metric)
+                and not isinstance(m, mean_like)
+            ]
+            if stateful:
+                logger.warning(
+                    "streamed validation evaluates the held-out tail in "
+                    "blocks and aggregates a row-weighted mean — exact "
+                    "for loss and mean-reduction metrics, approximate "
+                    "for %s (distribution-stateful); evaluate() on the "
+                    "full tail gives the exact value",
+                    stateful,
+                )
 
         def evaluate_blocks():
             totals: dict[str, float] = {}
@@ -729,9 +768,11 @@ class SparkModel:
         if names and set(names) == set(results):
             ordered = [results[k] for k in names]
         else:
-            if names:
+            if names and "compile_metrics" not in names:
                 # one keras version bump from silently mislabeled
-                # metrics — make the fallback visible (VERDICT r4 #8)
+                # metrics — make the fallback visible (VERDICT r4 #8).
+                # keras 3's lumped ['loss', 'compile_metrics'] view is
+                # the NORMAL case, not a mismatch worth warning about.
                 logger.warning(
                     "evaluate(): model.metrics_names %s does not match "
                     "the computed result keys %s — falling back to "
@@ -777,12 +818,13 @@ class SparkModel:
         from elephas_tpu.models.transformer import generate as _generate
 
         if self.pipeline_parallel > 1:
-            # dp=1 builds a 1-D ('stages',) mesh — only fan over the
-            # axes that exist (code-review r5)
+            # dp=1 builds a mesh without a 'data' axis — only fan over
+            # the axes that exist (code-review r5). Under PP×TP the
+            # model axis decodes TP-sharded like the pure-TP route.
             batch_axes = tuple(
                 a for a in ("data", "stages") if a in self.mesh.shape
             )
-            model_axis = None
+            model_axis = "model" if self.model_parallel > 1 else None
         elif self.sequence_parallel > 1:
             batch_axes = ("data", "seq")
             model_axis = "model" if self.model_parallel > 1 else None
@@ -829,6 +871,7 @@ class SparkModel:
                     num_microbatches=self.pipeline_microbatches,
                     mesh=self.mesh,
                     data_parallel=self.num_workers,
+                    model_parallel=self.model_parallel,
                 )
             elif self.sequence_parallel > 1:
                 # before the TP check: TP×SP routes here (the sequence

@@ -979,3 +979,81 @@ def test_two_process_generate(tmp_path):
     assert a["match"] and b["match"], (a, b)
     assert a["match_kv"] and b["match_kv"], (a, b)
     assert a["digest"] == b["digest"], (a, b)
+
+PPTP_SCRIPT = textwrap.dedent(
+    """
+    import json, hashlib
+    from elephas_tpu.parallel import distributed
+
+    assert distributed.initialize(), "gang init failed"
+    import jax
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    import numpy as np
+    import keras
+    from elephas_tpu import SparkModel
+
+    rng = np.random.default_rng(11)
+    n, d, k = 512, 8, 3
+    centers = rng.normal(size=(k, d)) * 2.0
+    y = rng.integers(0, k, size=n)
+    x = (centers[y] + rng.normal(size=(n, d)) * 0.5).astype(np.float32)
+    y = y.astype(np.int32)
+
+    keras.utils.set_random_seed(9)
+    model = keras.Sequential([
+        keras.layers.Input((d,)),
+        keras.layers.Dense(32, activation="relu"),
+        keras.layers.Dense(24, activation="relu"),
+        keras.layers.Dense(k, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.optimizers.Adam(1e-2),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    # 2x2x2 ('data','stages','model') mesh SPANNING both processes:
+    # ring hops AND Megatron psums cross the process gap in one program
+    sm = SparkModel(model, pipeline_parallel=2, model_parallel=2,
+                    num_workers=2)
+    assert dict(sm.mesh.shape) == {
+        "data": 2, "stages": 2, "model": 2,
+    }, sm.mesh.shape
+    spans = {dv.process_index for dv in sm.mesh.devices.flat}
+    assert spans == {0, 1}, spans
+
+    history = sm.fit((x, y), epochs=5, batch_size=64)
+    preds = sm.predict(x[:128])
+    acc = float((preds.argmax(1) == y[:128]).mean())
+
+    digest = hashlib.sha256(
+        b"".join(np.ascontiguousarray(w, dtype=np.float32).tobytes()
+                 for w in model.get_weights())
+    ).hexdigest()
+    print("PPTP " + json.dumps({
+        "process": jax.process_index(),
+        "digest": digest,
+        "final_loss": history["loss"][-1],
+        "final_acc": history["accuracy"][-1],
+        "predict_acc": acc,
+    }), flush=True)
+    """
+)
+
+
+def test_two_process_pp_tp_composition(tmp_path):
+    """r5 (VERDICT r4 #4): DP×PP×TP spans a 2-process gang — the stage
+    ring's ppermute and the in-stage Megatron psums both cross the
+    process boundary in ONE program; both processes converge to
+    identical weights and the task is learned."""
+    rc, output = _run_gang(str(tmp_path), PPTP_SCRIPT)
+    assert rc == 0, output[-3000:]
+    results = [
+        json.loads(line.split("PPTP ", 1)[1])
+        for line in output.splitlines()
+        if "PPTP " in line
+    ]
+    assert len(results) == 2, output[-3000:]
+    a, b = sorted(results, key=lambda r: r["process"])
+    assert a["digest"] == b["digest"], (a, b)
+    assert a["final_acc"] > 0.85, a
+    assert a["predict_acc"] > 0.85, a

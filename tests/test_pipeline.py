@@ -383,8 +383,10 @@ def test_pipeline_parallel_guards(blobs):
     from elephas_tpu import SparkModel
 
     x, y, d, k = blobs
-    with pytest.raises(ValueError, match="depth-exclusive"):
-        SparkModel(_pp_mlp(d, k), model_parallel=2, pipeline_parallel=2)
+    # r5: model_parallel COMPOSES with the pipeline now (PP×TP,
+    # tests/test_pp_tp.py); sequence_parallel stays excluded
+    with pytest.raises(ValueError, match="cannot compose"):
+        SparkModel(_pp_mlp(d, k), sequence_parallel=2, pipeline_parallel=2)
     with pytest.raises(ValueError, match="synchronous"):
         SparkModel(_pp_mlp(d, k), mode="asynchronous", pipeline_parallel=2)
 

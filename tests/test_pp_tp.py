@@ -1,0 +1,167 @@
+"""PP×TP composition (r5, VERDICT r4 #4) — the canonical TPU training
+stack: depth over the pipeline ring, width Megatron-sharded inside each
+stage, data replicas around both.
+
+Design under test (parallel/pipeline_runner.py, ops/pipeline.py): the
+('data','stages','model') mesh is FULLY mapped; stage programs run
+Megatron manually (column-split Dense → local, row-split Dense →
+psum over 'model', head-split FlashMHA) because a GSPMD-auto model axis
+emits global-group collectives inside the stage `lax.switch` and
+deadlocks. Weight storage splits [S, mp, P_max] over P(stages, model) —
+each device holds 1/(S·mp) of weights, grads, and adam slots.
+"""
+
+import numpy as np
+import pytest
+
+
+def _mlp(d, k, seed=0, lr=1e-2):
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((d,)),
+            keras.layers.Dense(48, activation="relu", name="fc1"),
+            keras.layers.Dense(32, activation="relu", name="fc2"),
+            keras.layers.Dense(24, activation="relu", name="fc3"),
+            keras.layers.Dense(k, activation="softmax", name="head"),
+        ]
+    )
+    model.compile(
+        optimizer=keras.optimizers.Adam(lr),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    return model
+
+
+def test_pp_tp_mlp_matches_keras(blobs):
+    """DP×PP×TP on all 8 devices (2×2×2) trains an MLP to keras
+    oracle parity: same losses, same metrics, same final weights."""
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    x, y = x[:256], y[:256]
+    sm = SparkModel(_mlp(d, k, seed=73), pipeline_parallel=2,
+                    model_parallel=2, pipeline_microbatches=4,
+                    num_workers=2)
+    assert dict(sm.mesh.shape) == {"data": 2, "stages": 2, "model": 2}
+    h = sm.fit((x, y), epochs=4, batch_size=64)
+    ref = _mlp(d, k, seed=73)
+    h_ref = ref.fit(x, y, epochs=4, batch_size=64, shuffle=False, verbose=0)
+    np.testing.assert_allclose(h["loss"], h_ref.history["loss"], rtol=1e-3)
+    np.testing.assert_allclose(
+        h["accuracy"], h_ref.history["accuracy"], rtol=1e-3
+    )
+    for a, b in zip(sm.master_network.get_weights(), ref.get_weights()):
+        np.testing.assert_allclose(a, b, atol=2e-3, rtol=2e-3)
+    # the L5 inference surface runs on the composed mesh too
+    preds = sm.predict(x[:64])
+    assert preds.shape == (64, k)
+    np.testing.assert_allclose(
+        preds, np.asarray(ref(x[:64])), atol=2e-3, rtol=2e-3
+    )
+
+
+def test_pp_tp_transformer_matches_keras():
+    """A transformer LM through PP×TP: the plan Megatron-pairs the MLP
+    denses, head-splits FlashMHA, column-splits the vocab head (with a
+    stage-output gather), and training matches keras exactly."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import transformer_lm
+
+    maxlen, vocab, n = 16, 8, 256
+    rng = np.random.default_rng(0)
+    starts = rng.integers(2, 6, size=n)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+
+    def lm(seed):
+        return transformer_lm(
+            vocab_size=vocab, maxlen=maxlen, d_model=32, num_heads=2,
+            num_layers=2, dropout=0.0, lr=1e-2, seed=seed,
+        )
+
+    m = lm(0)
+    sm = SparkModel(m, pipeline_parallel=2, model_parallel=2,
+                    pipeline_microbatches=4, num_workers=2)
+    runner = sm._get_runner()
+    kinds = [
+        kind
+        for plans, _gout in runner._tp_plans
+        for kind, _g in plans.values()
+    ]
+    assert "flash_tp" in kinds, kinds
+    assert "dense_col" in kinds and "dense_row" in kinds, kinds
+    h = sm.fit((x, y), epochs=3, batch_size=32)
+    ref = lm(0)
+    h_ref = ref.fit(x, y, epochs=3, batch_size=32, shuffle=False, verbose=0)
+    np.testing.assert_allclose(h["loss"], h_ref.history["loss"], rtol=2e-3)
+    for a, b in zip(sm.master_network.get_weights(), ref.get_weights()):
+        np.testing.assert_allclose(a, b, atol=3e-3, rtol=3e-3)
+
+    # the PP×TP-trained model decodes on the SAME mesh (r5 generate)
+    from elephas_tpu.models import generate
+
+    prompt = np.array([[2, 3, 4, 5]], np.int32)
+    np.testing.assert_array_equal(
+        sm.generate(prompt, steps=6), generate(m, prompt, steps=6)
+    )
+
+
+def test_pp_tp_storage_is_rank_sharded():
+    """The point of the composition: each device stores 1/(S·mp) of the
+    parameters — the stacked buffer is [S, mp, P_max] over
+    P('stages','model'), and P_max shrinks vs. PP-only."""
+    from elephas_tpu import SparkModel
+
+    sm = SparkModel(_mlp(10, 3, seed=1), pipeline_parallel=2,
+                    model_parallel=2, num_workers=2)
+    t = sm._get_runner().trainer
+    assert t.params.ndim == 3 and t.params.shape[:2] == (2, 2)
+    spec = t.params.sharding.spec
+    assert tuple(spec[:2]) == ("stages", "model"), spec
+
+    sm_pp = SparkModel(_mlp(10, 3, seed=1), pipeline_parallel=2,
+                       num_workers=2)
+    t_pp = sm_pp._get_runner().trainer
+    # rank shards hold roughly half the per-stage weights
+    assert t.P_max < t_pp.P_max, (t.P_max, t_pp.P_max)
+
+
+def test_pp_tp_checkpoint_roundtrip(tmp_path, blobs):
+    """save_checkpoint/restore_checkpoint round-trips the rank-sharded
+    [S, mp, P] buffers."""
+    from elephas_tpu import SparkModel
+
+    x, y, d, k = blobs
+    sm = SparkModel(_mlp(d, k, seed=5), pipeline_parallel=2,
+                    model_parallel=2, num_workers=2)
+    sm.fit((x[:128], y[:128]), epochs=2, batch_size=32,
+           checkpoint_dir=str(tmp_path))
+    w_trained = [np.copy(w) for w in sm.master_network.get_weights()]
+
+    sm2 = SparkModel(_mlp(d, k, seed=5), pipeline_parallel=2,
+                     model_parallel=2, num_workers=2)
+    h = sm2.fit((x[:128], y[:128]), epochs=2, batch_size=32,
+                checkpoint_dir=str(tmp_path), resume=True)
+    assert h["loss"] == []  # nothing left to train
+    for a, b in zip(sm2.master_network.get_weights(), w_trained):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_pp_sp_still_excluded():
+    """pipeline × sequence stays excluded; the error says what composes."""
+    from elephas_tpu import SparkModel
+
+    with pytest.raises(ValueError, match="cannot compose"):
+        SparkModel(_mlp(10, 3), pipeline_parallel=2, sequence_parallel=2)
+
+
+def test_pp_tp_device_budget_guard():
+    """pp × mp exceeding the device count raises up front."""
+    from elephas_tpu import SparkModel
+
+    with pytest.raises(ValueError, match="exceeds"):
+        SparkModel(_mlp(10, 3), pipeline_parallel=4, model_parallel=4)
