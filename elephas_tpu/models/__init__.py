@@ -31,11 +31,21 @@ __all__ = [
     "generate",
     "switch_transformer_classifier",
     "MoeFFN",
+    "FlashMHA",
+    "FusedLayerNorm",
 ]
 
 
 def __getattr__(name):
     # lazily resolve layer classes that require keras at definition time
+    if name == "FlashMHA":
+        from elephas_tpu.models.transformer import _flash_mha_layer
+
+        return _flash_mha_layer()
+    if name == "FusedLayerNorm":
+        from elephas_tpu.models.transformer import _fused_ln_layer
+
+        return _fused_ln_layer()
     if name == "MoeFFN":
         from elephas_tpu.models.switch import MoeFFN
 

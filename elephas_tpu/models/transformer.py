@@ -155,16 +155,98 @@ def _flash_mha_layer():
     return FlashMHA
 
 
+_FUSED_LN_CLS = None
+
+
+def _fused_ln_layer():
+    """The FusedLayerNorm layer class (lazy, serializer-registered —
+    same pattern as :func:`_flash_mha_layer`). Normalization runs the
+    one-pass Pallas kernel (:mod:`elephas_tpu.ops.layer_norm`): the r4
+    trace billed ~20% of transformer device time to XLA's multi-pass
+    layernorm fusions + their bf16↔f32 converts (VERDICT r4 #3a).
+    Under a sequence-parallel scope the math falls back to plain jnp
+    ops so GSPMD shards the normalization with the seq-sharded
+    activations instead of forcing the kernel replicated."""
+    global _FUSED_LN_CLS
+    if _FUSED_LN_CLS is not None:
+        return _FUSED_LN_CLS
+    import keras
+
+    @keras.saving.register_keras_serializable(package="elephas_tpu")
+    class FusedLayerNorm(keras.layers.Layer):
+        """LayerNormalization (last axis, keras-equivalent math: f32
+        statistics, affine gamma/beta) over one fused Pallas pass."""
+
+        def __init__(self, epsilon: float = 1e-6, **kwargs):
+            super().__init__(**kwargs)
+            self.epsilon = float(epsilon)
+
+        def build(self, input_shape):
+            d = int(input_shape[-1])
+            self.gamma = self.add_weight(
+                name="gamma", shape=(d,), initializer="ones"
+            )
+            self.beta = self.add_weight(
+                name="beta", shape=(d,), initializer="zeros"
+            )
+            super().build(input_shape)
+
+        def call(self, x):
+            import jax
+            import jax.numpy as jnp
+
+            from elephas_tpu.parallel.sequence import (
+                active_sequence_scope,
+            )
+
+            gamma, beta = self.gamma.value, self.beta.value
+            if active_sequence_scope() is not None:
+                x32 = jnp.asarray(x, jnp.float32)
+                mean = jnp.mean(x32, axis=-1, keepdims=True)
+                xc = x32 - mean
+                var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+                y = xc * jax.lax.rsqrt(var + self.epsilon)
+                return (y * gamma + beta).astype(x.dtype)
+
+            from elephas_tpu.ops.layer_norm import layer_norm
+
+            return layer_norm(x, gamma, beta, eps=self.epsilon)
+
+        def compute_output_shape(self, input_shape):
+            # keras's symbolic build traces call() with a polymorphic
+            # batch dim otherwise — the kernel's row flatten needs
+            # concrete rows (shape is identity anyway)
+            return input_shape
+
+        def get_config(self):
+            config = super().get_config()
+            config.update(epsilon=self.epsilon)
+            return config
+
+    _FUSED_LN_CLS = FusedLayerNorm
+    return FusedLayerNorm
+
+
 def __getattr__(name):
     # `from elephas_tpu.models.transformer import FlashMHA` resolves to
     # the real (lazily created) layer class
     if name == "FlashMHA":
         return _flash_mha_layer()
+    if name == "FusedLayerNorm":
+        return _fused_ln_layer()
     raise AttributeError(name)
 
 
 def _block(x, num_heads, head_dim, mlp_ratio, dropout, causal, name, L,
            FlashMHA, rope=False):
+    # keras LayerNormalization on purpose, A/B-measured (r5): the
+    # in-tree Pallas FusedLayerNorm (one-pass fwd, one-pass bwd with
+    # in-kernel dgamma/dbeta) reaches only PARITY end-to-end on v5e
+    # (220.4-221.4k tok/s fused vs 221.9-223.0k keras-LN, same
+    # session) — both run at the platform's realized elementwise
+    # bandwidth, so the simpler stock layer wins on compatibility.
+    # FusedLayerNorm stays available (elephas_tpu.models) for shapes
+    # where a single fused pass wins.
     h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln1")(x)
     h = FlashMHA(num_heads, head_dim, causal=causal, rope=rope,
                  name=f"{name}_attn")(h)

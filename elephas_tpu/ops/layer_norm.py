@@ -1,0 +1,173 @@
+"""Fused LayerNorm — a one-pass Pallas row kernel, with an honest
+measurement story.
+
+Motivation: the r4/r5 transformer traces bill ~30% of device time to
+layernorm-class fusions (``divide_subtract_fusion``,
+``multiply_reduce_fusion``) plus bf16↔f32 convert traffic. This kernel
+does the whole forward — f32 statistics, normalize, affine — in ONE
+pass per row block (bf16 in/out, converts in registers), and the whole
+backward (dx AND dgamma/dbeta, accumulated in VMEM scratch across the
+sequential row grid) in one more pass.
+
+MEASURED OUTCOME (r5, v5e, d=1024 preset — VERDICT r4 #3a): parity,
+not a win. End-to-end transformer bench: 220.4–221.4k tok/s with this
+kernel vs 221.9–223.0k with stock ``keras.layers.LayerNormalization``
+(same session); per-op A/B agrees (~2.5 ms fwd+bwd either way at
+[32768, 1024]). Both implementations sit at the platform's REALIZED
+elementwise bandwidth (~50–100 GB/s on this chip class), i.e. the
+layernorm share of the trace is a bandwidth bound, not a fusion
+deficiency — which is why the in-tree transformer builders keep the
+stock layer, and why raising arithmetic intensity (d_model 2048) lifts
+the same code path from ~35% to 47.2% MFU. The op stays exported
+(``elephas_tpu.models.FusedLayerNorm``) for shapes where one fused
+pass wins.
+
+The op carries a ``jax.custom_vjp`` and runs in Pallas interpreter
+mode off-TPU (tests), one code path — same structure as
+:mod:`elephas_tpu.ops.flash_attention`. Reference parity: the
+reference has no norm op of its own (keras layers); this is a
+TPU-native extension (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_ROW_BLOCKS = (256, 512, 128, 64, 32, 16, 8, 4, 2, 1)
+
+
+def _row_block(n: int) -> int:
+    for b in _ROW_BLOCKS:
+        if n % b == 0:
+            return b
+    return 1
+
+
+def _fwd_kernel(x_ref, g_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)  # [BR, D]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = xc * rstd * g_ref[:].astype(jnp.float32) + b_ref[:].astype(
+        jnp.float32
+    )
+    o_ref[:] = y.astype(o_ref.dtype)
+    mean_ref[:] = mean
+    rstd_ref[:] = rstd
+
+
+def _bwd_kernel(x_ref, g_ref, dy_ref, mean_ref, rstd_ref,
+                dx_ref, dg_ref, db_ref, dg_acc, db_acc):
+    # ONE pass produces dx AND the parameter grads: dgamma/dbeta
+    # accumulate in VMEM scratch across the (sequential) row grid and
+    # write out on the last step — no second XLA pass re-reading x
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dg_acc[:] = jnp.zeros_like(dg_acc)
+        db_acc[:] = jnp.zeros_like(db_acc)
+
+    x = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    xhat = (x - mean_ref[:]) * rstd
+    wdy = dy * g
+    c1 = jnp.mean(wdy, axis=-1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = ((wdy - c1 - xhat * c2) * rstd).astype(dx_ref.dtype)
+    dg_acc[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_acc[:] += jnp.sum(dy, axis=0, keepdims=True)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _finalize():
+        dg_ref[:] = dg_acc[:]
+        db_ref[:] = db_acc[:]
+
+
+def _fwd_call(x2, gamma, beta, eps, interpret):
+    n, d = x2.shape
+    br = _row_block(n)
+    grid = (n // br,)
+    row_spec = pl.BlockSpec((br, d), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    stat_spec = pl.BlockSpec((br, 1), lambda i: (i, 0))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[row_spec, vec_spec, vec_spec],
+        out_specs=[row_spec, stat_spec, stat_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, d), x2.dtype),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, gamma[None], beta[None])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _layer_norm2(x2, gamma, beta, eps, interpret):
+    y, _m, _r = _fwd_call(x2, gamma, beta, eps, interpret)
+    return y
+
+
+def _ln_fwd_rule(x2, gamma, beta, eps, interpret):
+    y, mean, rstd = _fwd_call(x2, gamma, beta, eps, interpret)
+    return y, (x2, gamma, mean, rstd)
+
+
+def _ln_bwd_rule(eps, interpret, residuals, dy):
+    from jax.experimental.pallas import tpu as pltpu
+
+    x2, gamma, mean, rstd = residuals
+    n, d = x2.shape
+    br = _row_block(n)
+    row_spec = pl.BlockSpec((br, d), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    stat_spec = pl.BlockSpec((br, 1), lambda i: (i, 0))
+    dx, dg, db = pl.pallas_call(
+        _bwd_kernel,
+        grid=(n // br,),
+        in_specs=[row_spec, vec_spec, row_spec, stat_spec, stat_spec],
+        out_specs=[row_spec, vec_spec, vec_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, d), x2.dtype),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, gamma[None], dy, mean, rstd)
+    return dx, dg[0].astype(gamma.dtype), db[0].astype(gamma.dtype)
+
+
+_layer_norm2.defvjp(_ln_fwd_rule, _ln_bwd_rule)
+
+
+def layer_norm(x, gamma, beta, eps: float = 1e-6,
+               interpret: bool | None = None):
+    """LayerNormalization over the LAST axis of ``x`` (any leading
+    shape), keras-equivalent math: f32 mean/variance statistics, affine
+    ``gamma``/``beta``, output in ``x``'s dtype. One fused pass forward
+    and one for ``dx`` backward."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    n = 1
+    for s in lead:
+        n *= int(s)
+    y = _layer_norm2(
+        x.reshape(n, d), gamma, beta, float(eps), bool(interpret)
+    )
+    return y.reshape(x.shape)
