@@ -1267,3 +1267,15 @@ class PipelineRunner:
     def stage_summary(self) -> list[list[str]]:
         """Layer names per stage (tests/debugging)."""
         return [[l.name for l in g] for g in self._stage_layers]
+
+    def tp_plan_summary(self) -> dict[str, int]:
+        """Megatron handler counts across all stages under PP×TP
+        (empty when ``model_parallel == 1``) — the public view of the
+        plan for examples/diagnostics."""
+        counts: dict[str, int] = {}
+        if not self._tp_plans:
+            return counts
+        for plans, _gather_out in self._tp_plans:
+            for kind, _g in plans.values():
+                counts[kind] = counts.get(kind, 0) + 1
+        return counts

@@ -1,0 +1,94 @@
+"""DP×PP×TP: the canonical TPU training stack behind one constructor —
+then decode the same model ON the mesh.
+
+``SparkModel(pipeline_parallel=2, model_parallel=2, num_workers=2)``
+composes all three parallelism families (r5): transformer depth rides
+the GPipe activation ring over the 'stages' axis, each stage's weights
+Megatron-shard over the 'model' axis INSIDE the ring (column-split
+qkv/mlp-up, row-split proj/mlp-down with a psum, head-split FlashMHA),
+and data replicas wrap around both — a ``('data','stages','model')``
+mesh where every device stores 1/(stages·model) of the weights, grads,
+and adam slots. Training matches single-device keras exactly.
+
+``SparkModel.generate`` then decodes the trained LM on the SAME mesh:
+batch fanned across data×stages, weights TP-sharded through the decode
+loop — the model never needs to fit one chip at any point in its life.
+
+The task: periodic sequences (cycle 2..5 with random phase); a correct
+LM continues the period from any prompt.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--maxlen", type=int, default=16)
+    p.add_argument("--vocab", type=int, default=16)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--steps", type=int, default=8)
+    args = p.parse_args()
+
+    import elephas_tpu  # noqa: F401  (jax backend before keras)
+    import jax
+
+    if len(jax.devices()) < 8:
+        # the 2×2×2 mesh needs 8 devices; fall back to a virtual CPU
+        # mesh (same mechanism as the driver's multi-chip dry run)
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+        jax.config.update("jax_num_cpu_devices", 8)
+        jax.config.update("jax_platforms", "cpu")
+        print("fewer than 8 accelerators: using an 8-device virtual "
+              "CPU mesh")
+
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate, transformer_lm
+
+    maxlen, vocab, n = args.maxlen, args.vocab, 512
+    rng = np.random.default_rng(0)
+    starts = rng.integers(2, 6, size=n)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2  # cycle 2..5
+    x = seq[:, :-1].astype(np.int32)
+    y = seq[:, 1:].astype(np.int32)
+
+    model = transformer_lm(
+        vocab_size=vocab, maxlen=maxlen, d_model=32, num_heads=2,
+        num_layers=2, dropout=0.0, lr=1e-2, seed=0,
+    )
+    sm = SparkModel(
+        model, pipeline_parallel=2, model_parallel=2,
+        pipeline_microbatches=4, num_workers=2,
+    )
+    print(f"mesh: {dict(sm.mesh.shape)}")
+    history = sm.fit((x, y), epochs=args.epochs, batch_size=32)
+    plan = sm._get_runner().tp_plan_summary()
+    print(
+        f"Megatron plan: {plan.get('dense_col', 0)} column-split + "
+        f"{plan.get('dense_row', 0)} row-split denses, "
+        f"{plan.get('flash_tp', 0)} head-split attentions, "
+        f"{plan.get('replicated', 0)} replicated ops"
+    )
+    print(
+        f"PP×TP LM loss: {history['loss'][0]:.3f} -> "
+        f"{history['loss'][-1]:.3f}, "
+        f"next-token acc: {history['accuracy'][-1]:.3f}"
+    )
+
+    prompt = np.array([[2, 3, 4, 5], [5, 2, 3, 4]], np.int32)
+    mesh_tokens = sm.generate(prompt, steps=args.steps)
+    single = generate(model, prompt, steps=args.steps)
+    assert (mesh_tokens == single).all(), "mesh decode must match"
+    for row in mesh_tokens:
+        print("mesh-decoded:", row.tolist())
+        expect = [(row[0] - 2 + i) % 4 + 2 for i in range(len(row))]
+        assert row.tolist() == expect, (row.tolist(), expect)
+    print("decoded on the ('data','stages','model') mesh — tokens match "
+          "single-device greedy exactly")
+
+
+if __name__ == "__main__":
+    main()

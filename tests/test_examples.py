@@ -81,3 +81,7 @@ def test_resnet50_tiny():
 def test_lm_generate():
     run_example("lm_generate", ["--maxlen", "16", "--epochs", "8",
                                 "--steps", "8"])
+
+
+def test_pp_tp_transformer():
+    run_example("pp_tp_transformer", ["--epochs", "6"])
