@@ -161,6 +161,92 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
     return out, lse[:, 0, :]
 
 
+def _fwd_kernel_grouped(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                        m_ref, l_ref, *, scale: float, causal: bool,
+                        block_q: int, block_k: int, hp: int, d: int):
+    """Online-softmax forward over a GROUP of ``hp`` lane-packed heads.
+
+    Blocks arrive ``[BQ, hp·d]`` — ``hp`` heads side by side filling a
+    128-lane tile (r5, VERDICT r4 #3c: head_dim-64 models previously
+    fell back to the transposed layout and paid its copy kernels).
+    Heads stay separate WITHOUT lane reshapes (Mosaic rejects the
+    vector shape cast): each head's score dot runs over all ``hp·d``
+    lanes with the OTHER heads' k lanes zeroed — mathematically the
+    head's own ``d``-deep contraction, and the same MXU occupancy the
+    transposed fallback gets from a ``d``-deep dot. Per-head softmax
+    state lives in ``[hp, BQ, 1]`` scratch; the accumulator stays in
+    the packed ``[BQ, hp·d]`` layout with per-head rescaling applied
+    through lane masks."""
+    j = pl.program_id(2)
+    last_j = pl.num_programs(2) - 1
+    w = hp * d
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[:]  # [BQ, hp·d]
+    k = k_ref[:]  # [BK, hp·d]
+    v = v_ref[:]
+    lanes_k = jax.lax.broadcasted_iota(jnp.int32, (block_k, w), 1)
+    lanes_q = jax.lax.broadcasted_iota(jnp.int32, (block_q, w), 1)
+    if causal:
+        i = pl.program_id(1)
+        rows = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        visible = cols <= rows
+
+    for t in range(hp):
+        sel_k = (lanes_k >= t * d) & (lanes_k < (t + 1) * d)
+        sel_q = (lanes_q >= t * d) & (lanes_q < (t + 1) * d)
+        k_t = jnp.where(sel_k, k, 0)
+        # zeroed foreign lanes contribute nothing: this IS q_t · k_tᵀ
+        s = jax.lax.dot_general(
+            q, k_t,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [BQ, BK]
+        if causal:
+            s = jnp.where(visible, s, NEG_INF)
+        m_prev = m_ref[t]  # [BQ, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # fully-masked-so-far rows would accumulate phantom mass (see
+        # _fwd_kernel) — zero them so l stays 0
+        p = jnp.where(m_new <= NEG_INF * 0.5, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[t] = l_ref[t] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[t] = m_new
+        v_t = jnp.where(sel_k, v, 0).astype(jnp.float32)
+        contrib = jax.lax.dot_general(
+            p, v_t,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, hp·d], nonzero only on head t's lanes
+        acc_ref[:] = jnp.where(
+            sel_q, acc_ref[:] * alpha + contrib, acc_ref[:]
+        )
+
+    @pl.when(j == last_j)
+    def _finalize():
+        l_packed = jnp.ones((block_q, w), jnp.float32)
+        for t in range(hp):
+            sel_q = (lanes_q >= t * d) & (lanes_q < (t + 1) * d)
+            l_t = l_ref[t]
+            l_packed = jnp.where(
+                sel_q, jnp.where(l_t == 0.0, 1.0, l_t), l_packed
+            )
+            safe_l = jnp.where(l_t == 0.0, 1.0, l_t)
+            lse_ref[t, :] = (m_ref[t] + jnp.log(safe_l))[:, 0]
+        o_ref[:] = (acc_ref[:] / l_packed).astype(o_ref.dtype)
+
+
 def _flash_forward_packed(qkv, h, d, scale, causal, block_q, block_k,
                           interpret):
     """Packed qkv → (out ``[B, S, H·D]``, lse ``[B·H, S]``).
@@ -171,9 +257,16 @@ def _flash_forward_packed(qkv, h, d, scale, causal, block_q, block_k,
     ``h`` / ``H+h`` / ``2H+h`` in D-sized blocks), so the
     [B,S,H,D]→[B,H,S,D] transposes — the top copy kernels in the r4
     trace — never materialize, and the output lands sequence-major
-    ready for the out-projection. Mosaic's tiling rule makes this
-    layout legal only when ``D % 128 == 0`` (the last BLOCK dim must be
-    128-divisible or span the array dim); callers gate on that."""
+    ready for the out-projection. Mosaic's tiling rule needs the last
+    BLOCK dim 128-divisible: ``D % 128 == 0`` uses per-head blocks;
+    smaller head dims with ``128 % D == 0`` lane-pack ``128 // D``
+    heads per block (r5) via :func:`_fwd_kernel_grouped`; callers gate
+    on ``packed_layout_supported``."""
+    if d % 128:
+        # head_dim 64: two heads lane-packed per 128-wide block
+        return _flash_forward_packed_grouped(
+            qkv, h, d, scale, causal, block_q, block_k, interpret
+        )
     b, s, fused = qkv.shape
     assert fused == 3 * h * d, (qkv.shape, h, d)
     block_q, block_k = _resolve_blocks(block_q, block_k, s, s)
@@ -221,6 +314,83 @@ def _flash_forward_packed(qkv, h, d, scale, causal, block_q, block_k,
         interpret=interpret,
     )(qkv, qkv, qkv)
     return out, lse[:, 0, :]
+
+
+def _flash_forward_packed_grouped(qkv, h, d, scale, causal, block_q,
+                                  block_k, interpret):
+    """Packed forward for small head dims: ``hp = 128 // d`` heads ride
+    each 128-lane block (r5). Same index-map structure as the per-head
+    path with groups in place of heads; heads within a group are
+    contiguous in the fused layout, so the output flattens straight to
+    ``[B, S, H·D]`` and lse to ``[B·H, S]``."""
+    b, s, fused = qkv.shape
+    assert fused == 3 * h * d, (qkv.shape, h, d)
+    assert 128 % d == 0 and h % (128 // d) == 0, (
+        "grouped layout needs 128 % head_dim == 0 and an even group "
+        "split — gate callers on packed_layout_supported", h, d,
+    )
+    hp = 128 // d
+    ng = h // hp  # lane groups per q/k/v region
+    block_q, block_k = _resolve_blocks(block_q, block_k, s, s)
+    grid = (b * ng, s // block_q, s // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel_grouped,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        hp=hp,
+        d=d,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (None, block_q, hp * d),
+                lambda bg, i, j, ng=ng: (bg // ng, i, bg % ng),
+            ),
+            pl.BlockSpec(
+                (None, block_k, hp * d),
+                lambda bg, i, j, ng=ng: (bg // ng, j, ng + bg % ng),
+            ),
+            pl.BlockSpec(
+                (None, block_k, hp * d),
+                lambda bg, i, j, ng=ng: (bg // ng, j, 2 * ng + bg % ng),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (None, block_q, hp * d),
+                lambda bg, i, j, ng=ng: (bg // ng, i, bg % ng),
+            ),
+            pl.BlockSpec((None, hp, block_q), lambda bg, i, j: (bg, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, h * d), qkv.dtype),
+            jax.ShapeDtypeStruct((b * ng, hp, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hp * d), jnp.float32),
+            pltpu.VMEM((hp, block_q, 1), jnp.float32),
+            pltpu.VMEM((hp, block_q, 1), jnp.float32),
+        ],
+        cost_estimate=_cost(b * h, s, s, d, qkv.dtype.itemsize),
+        interpret=interpret,
+    )(qkv, qkv, qkv)
+    # [B·NG, hp, S] → [B·H, S]: group-major × within-group IS the head
+    # order (heads of a group are lane-contiguous in the fused layout)
+    return out, lse.reshape(b * h, s)
+
+
+def packed_layout_supported(d: int, h: int) -> bool:
+    """Can the packed-qkv kernels express this (head_dim, heads)?
+    128-multiples use per-head blocks; head_dim 64 lane-packs 2 heads
+    per block (even head counts). Smaller head dims would multiply the
+    masked-dot MAC waste past the fallback's copy cost, so they take
+    the transposed layout."""
+    return d % 128 == 0 or (d == 64 and h % 2 == 0)
 
 
 # -- blockwise backward (flash recurrences, XLA-fused) ------------------
@@ -404,10 +574,13 @@ def flash_attention_qkv(
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     b, s, _, h, d = qkv.shape
-    if int(d) % 128 and not interpret:
-        # Mosaic's tiling rule rejects D-sized last-dim blocks unless
-        # D % 128 == 0 — small head dims take the transposed layout
-        # (same math, with the copy cost the packed path avoids)
+    if not packed_layout_supported(int(d), int(h)):
+        # Mosaic's tiling rule needs 128-divisible last-dim blocks.
+        # D % 128 == 0 → per-head blocks; divisors of 128 lane-pack
+        # 128//D heads per block (r5 — head_dim-64 models no longer pay
+        # the transpose copies); anything else (or an odd head count)
+        # takes the transposed layout — same math, with the copy cost
+        # the packed path avoids
         qkv_t = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3, B, H, S, D]
         out = _flash_attention_bhsd(
             qkv_t[0].reshape(b * h, s, d),
