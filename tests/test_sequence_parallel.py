@@ -163,7 +163,8 @@ def test_sequence_parallel_guards():
     # pipeline stays exclusive
     sm = SparkModel(model, model_parallel=2, sequence_parallel=2)
     assert dict(sm.mesh.shape) == {"data": 2, "seq": 2, "model": 2}
-    with pytest.raises(ValueError, match="depth-exclusive"):
+    # r5: PP×TP composes now; pipeline × sequence is what stays out
+    with pytest.raises(ValueError, match="cannot compose"):
         SparkModel(model, pipeline_parallel=2, sequence_parallel=2)
     with pytest.raises(ValueError, match="synchronously"):
         SparkModel(model, mode="asynchronous", sequence_parallel=2)
