@@ -483,6 +483,31 @@ def _finish_decode(model, run, wargs, tokens0, key, mesh, batch_axes,
     return host_read(out, mesh)[:n_rows, :n_cols]
 
 
+def _validate_decode_args(model, prompt, steps, top_k, top_p):
+    """Shared decode-argument validation (also used by the pipeline
+    ring decode): normalizes the prompt to ``[B, P]`` and checks the
+    length/sampling bounds against the model. Returns
+    ``(prompt, b, p, maxlen, vocab)``."""
+    prompt = np.asarray(prompt)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    b, p = prompt.shape
+    maxlen = int(model.inputs[0].shape[1])
+    vocab = int(model.outputs[0].shape[-1])
+    if p + steps > maxlen:
+        raise ValueError(
+            f"prompt ({p}) + steps ({steps}) exceeds the model's "
+            f"maxlen ({maxlen})"
+        )
+    if top_k is not None and not 0 < int(top_k) <= vocab:
+        raise ValueError(
+            f"top_k={top_k} outside (0, vocab={vocab}]"
+        )
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError(f"top_p={top_p} outside (0, 1]")
+    return prompt, b, p, maxlen, vocab
+
+
 def _decode_shardings(variables, mesh, model_axis, rules):
     """Per-variable NamedShardings for decoding under ``mesh``: the TP
     planner's layouts when a >1 ``model_axis`` exists, replicated
@@ -547,23 +572,9 @@ def generate(
     import jax
     import jax.numpy as jnp
 
-    prompt = np.asarray(prompt)
-    if prompt.ndim == 1:
-        prompt = prompt[None]
-    b, p = prompt.shape
-    maxlen = int(model.inputs[0].shape[1])
-    vocab = int(model.outputs[0].shape[-1])
-    if p + steps > maxlen:
-        raise ValueError(
-            f"prompt ({p}) + steps ({steps}) exceeds the model's "
-            f"maxlen ({maxlen})"
-        )
-    if top_k is not None and not 0 < int(top_k) <= vocab:
-        raise ValueError(
-            f"top_k={top_k} outside (0, vocab={vocab}]"
-        )
-    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
-        raise ValueError(f"top_p={top_p} outside (0, 1]")
+    prompt, b, p, maxlen, _vocab = _validate_decode_args(
+        model, prompt, steps, top_k, top_p
+    )
 
     pad = 0
     if mesh is not None:

@@ -808,18 +808,27 @@ class SparkModel:
           loop under the TP planner's layouts, and with
           ``kv_cache=True`` the per-layer K/V caches shard with the
           head axis;
-        - ``pipeline_parallel``: decode is depth-replicated — the stage
-          axis joins the batch axes instead (pipeline stages exist for
-          training-time memory; a stage-ring decode is not implemented).
+        - ``pipeline_parallel``: decode runs THROUGH the stage ring
+          (r5) — weights stay depth-sharded (and width-sharded under
+          PP×TP) for the whole generation, full-recompute per token.
+          ``kv_cache=True`` instead takes the depth-REPLICATED cached
+          decode (O(S·L), but the model must fit one device).
 
         Every gang process must make the identical call (SPMD
         contract); all return the full ``[B, P+steps]`` tokens.
         """
         from elephas_tpu.models.transformer import generate as _generate
 
+        if self.pipeline_parallel > 1 and not kv_cache:
+            return self._get_runner().generate(
+                prompt, steps, temperature=temperature, top_k=top_k,
+                top_p=top_p, seed=seed,
+            )
         if self.pipeline_parallel > 1:
-            # dp=1 builds a mesh without a 'data' axis — only fan over
-            # the axes that exist (code-review r5). Under PP×TP the
+            # kv_cache decode is depth-replicated: the per-layer caches
+            # live in one program — the stage axis joins the batch axes
+            # (dp=1 builds a mesh without a 'data' axis; only fan over
+            # the axes that exist — code-review r5). Under PP×TP the
             # model axis decodes TP-sharded like the pure-TP route.
             batch_axes = tuple(
                 a for a in ("data", "stages") if a in self.mesh.shape

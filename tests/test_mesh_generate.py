@@ -97,9 +97,12 @@ def test_sp_generate_uses_both_axes(lm):
     assert set(_batch_axes_of(lm)) == {"data", "seq"}
 
 
-def test_pp_generate_depth_replicated(lm):
-    """pipeline_parallel: decode replicates depth and fans the batch
-    over (data, stages) — documented fallback, exact tokens."""
+def test_pp_generate_through_the_ring(lm):
+    """pipeline_parallel (r5): greedy decode runs THROUGH the stage
+    ring — weights stay depth-sharded for the whole generation (the
+    introspection hook records their P('stages'…) layout) — and the
+    tokens match single-device decoding exactly. kv_cache=True takes
+    the depth-replicated cached decode and matches too."""
     from elephas_tpu import SparkModel
     from elephas_tpu.models import generate
 
@@ -107,12 +110,17 @@ def test_pp_generate_depth_replicated(lm):
     sm = SparkModel(lm, pipeline_parallel=2, num_workers=2)
     out = sm.generate(PROMPT, steps=8)
     np.testing.assert_array_equal(out, ref)
+    sh = lm._elephas_generate_param_sharding
+    assert sh.spec[0] == "stages", sh
+    out_kv = sm.generate(PROMPT, steps=8, kv_cache=True)
+    np.testing.assert_array_equal(out_kv, ref)
     assert set(_batch_axes_of(lm)) == {"data", "stages"}
 
 
 def test_pp_generate_default_workers_1d_mesh(lm):
     """pipeline_parallel with the DEFAULT num_workers builds a 1-D
-    ('stages',) mesh — generate must fan over the axes that exist
+    ('stages',) mesh — the ring decode runs there too, and the
+    kv-cache (replicated) route must fan over the axes that exist
     (code-review r5: hardcoded ('data','stages') raised here)."""
     from elephas_tpu import SparkModel
     from elephas_tpu.models import generate
@@ -122,7 +130,27 @@ def test_pp_generate_default_workers_1d_mesh(lm):
     assert tuple(sm.mesh.shape) == ("stages",), sm.mesh.shape
     out = sm.generate(PROMPT, steps=8)
     np.testing.assert_array_equal(out, ref)
+    assert lm._elephas_generate_param_sharding.spec[0] == "stages"
+    out_kv = sm.generate(PROMPT, steps=8, kv_cache=True)
+    np.testing.assert_array_equal(out_kv, ref)
     assert _batch_axes_of(lm) == ("stages",)
+
+
+def test_pp_ring_generate_chunks_large_batches(lm):
+    """r5 (code-review): a prompt batch beyond the compiled ring's
+    capacity decodes in chunks — every row comes back, matching the
+    single-device tokens (the first cut silently dropped the tail)."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate
+
+    sm = SparkModel(lm, pipeline_parallel=2, num_workers=2)
+    small = sm.generate(PROMPT, steps=8)  # compiles the ring at b=2
+    big_prompt = np.concatenate([PROMPT] * 5)  # b=10 > compiled batch
+    out = sm.generate(big_prompt, steps=8)
+    assert out.shape == (10, 12), out.shape
+    ref = generate(lm, big_prompt, steps=8)
+    np.testing.assert_array_equal(out, ref)
+    np.testing.assert_array_equal(out[:2], small)
 
 
 def test_tp_sampled_generate_deterministic_and_valid(lm):
