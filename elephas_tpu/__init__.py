@@ -47,7 +47,7 @@ if _backend != "jax":
         f"leave KERAS_BACKEND unset, or set KERAS_BACKEND=jax."
     )
 
-__version__ = "0.5.0"
+__version__ = "0.6.0"
 
 from elephas_tpu.spark_model import (  # noqa: E402,F401
     SparkModel,
