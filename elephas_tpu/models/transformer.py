@@ -443,15 +443,21 @@ def _mesh_fingerprint(mesh, batch_axes, model_axis):
 
 
 def _decode_jit_cache(model) -> dict:
-    """The per-model compiled-decode cache, BOUNDED: mesh-fingerprinted
-    keys would otherwise pin every leased submesh (devices + compiled
-    executables) alive for the model's lifetime (hyperparam trials lease
-    many). Insertion-ordered eviction, same spirit as the lru-bounded
-    gather cache in :mod:`elephas_tpu.parallel.mesh`."""
-    cache = model.__dict__.setdefault("_elephas_generate_jit", {})
-    while len(cache) > 16:
+    """The per-model compiled-decode cache, BOUNDED via
+    :func:`_cache_insert`: mesh-fingerprinted keys would otherwise pin
+    every leased submesh (devices + compiled executables) alive for the
+    model's lifetime (hyperparam trials lease many)."""
+    return model.__dict__.setdefault("_elephas_generate_jit", {})
+
+
+def _cache_insert(cache: dict, key, value, bound: int = 16):
+    """Insert then evict oldest entries past ``bound`` — eviction AFTER
+    insertion so the entry being served is never the one popped
+    (code-review r5: pre-insert eviction recompiled the round-robin
+    17th config on every call)."""
+    cache[key] = value
+    while len(cache) > bound:
         cache.pop(next(iter(cache)))
-    return cache
 
 
 def _finish_decode(model, run, wargs, tokens0, key, mesh, batch_axes,
@@ -635,7 +641,7 @@ def generate(
             tokens, _ = jax.lax.fori_loop(p, p + steps, step, (tokens, key))
             return tokens
 
-        cache[cache_key] = run
+        _cache_insert(cache, cache_key, run)
 
     if mesh is not None:
         from elephas_tpu.parallel.mesh import put_global
@@ -1114,7 +1120,7 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
             )
             return tokens
 
-        cache[cache_key] = run
+        _cache_insert(cache, cache_key, run)
 
     return _finish_decode(
         model, run, (weights,), tokens0, jax.random.PRNGKey(seed),

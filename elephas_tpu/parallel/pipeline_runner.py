@@ -492,6 +492,26 @@ class _TpReplan(Exception):
         self.layer_id = layer_id
 
 
+class _Overlay:
+    """Two-level tensor map for keras ``SymbolicArguments.fill_in``
+    (which only needs ``[]`` and ``.get``) — gathered values shadow the
+    base dict without copying it per node."""
+
+    __slots__ = ("top", "base")
+
+    def __init__(self, top, base):
+        self.top = top
+        self.base = base
+
+    def __getitem__(self, k):
+        return self.top[k] if k in self.top else self.base[k]
+
+    def get(self, k, default=None):
+        if k in self.top:
+            return self.top[k]
+        return self.base.get(k, default)
+
+
 def _graph_nodes(model):
     """Topologically ordered operation nodes of the model's functional
     graph (``keras.Sequential`` included via its underlying Functional),
@@ -811,9 +831,11 @@ class PipelineRunner:
                         id(node), ("replicated", ())
                     )
                     if gather_ids:
-                        local = dict(tensors)
-                        for kid in gather_ids:
-                            local[kid] = rep(kid)
+                        # overlay, not a full dict copy per node
+                        # (code-review r5 round sweep: O(nodes²) churn
+                        # on deep stage programs)
+                        overlay = {kid: rep(kid) for kid in gather_ids}
+                        local = _Overlay(overlay, tensors)
                     else:
                         local = tensors
                     args, kwargs = node.arguments.fill_in(local)
@@ -1273,9 +1295,9 @@ class PipelineRunner:
                 )
                 return tokens
 
-            while len(self._decode_cache) > 8:
-                self._decode_cache.pop(next(iter(self._decode_cache)))
-            self._decode_cache[cache_key] = run
+            from elephas_tpu.models.transformer import _cache_insert
+
+            _cache_insert(self._decode_cache, cache_key, run, bound=8)
 
         rep = jax.sharding.NamedSharding(
             t.mesh, jax.sharding.PartitionSpec()

@@ -204,6 +204,28 @@ def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
         head_axis = scope.data_axis
     else:
         head_axis = None
+    if (
+        data_axis is None and head_axis is None and mp == 1
+        and dp > 1 and (b * h) % dp == 0
+    ):
+        # neither dim tiles alone but their product does (r4's merged
+        # layout; code-review r5 round sweep) — WITHOUT a model axis
+        # the merged reshape is cliff-free, so keep that tiling rather
+        # than replicate
+        spec = P(scope.data_axis, scope.seq_axis, None)
+        fn3 = functools.partial(
+            ring_attention, axis_name=scope.seq_axis, causal=causal,
+            scale=scale,
+        )
+        sharded3 = jax.shard_map(
+            fn3, mesh=scope.mesh, in_specs=(spec,) * 3, out_specs=spec,
+            check_vma=False,
+        )
+        out = sharded3(
+            q.reshape(b * h, s, d), k.reshape(b * h, s, d),
+            v.reshape(b * h, s, d),
+        )
+        return out.reshape(b, h, s, d)
     head_axes = (
         head_axis if isinstance(head_axis, tuple)
         else () if head_axis is None else (head_axis,)

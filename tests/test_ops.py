@@ -440,3 +440,30 @@ def test_fused_layer_norm_layer_trains():
     )
     cfg = m1.get_layer(index=1).get_config()
     assert cfg["epsilon"] == 1e-6
+
+
+def test_fused_layer_norm_sp_scope_fallback():
+    """Under a sequence-parallel scope FusedLayerNorm takes the plain
+    jnp math (GSPMD shards it with the seq-sharded activations instead
+    of forcing the Pallas call replicated) — same numbers either way."""
+    import keras
+
+    from jax.sharding import Mesh
+
+    from elephas_tpu.models import FusedLayerNorm
+    from elephas_tpu.parallel.sequence import sequence_parallel_scope
+    from elephas_tpu.parallel.sequence import dp_sp_mesh
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 16, 32)).astype(np.float32)
+    keras.utils.set_random_seed(7)
+    ln = FusedLayerNorm(epsilon=1e-6)
+    ln.build(x.shape)
+    ln.gamma.assign(rng.normal(size=32).astype(np.float32))
+    ln.beta.assign(rng.normal(size=32).astype(np.float32))
+
+    out_plain = np.asarray(ln(x))
+    mesh = dp_sp_mesh(2, data_parallel=2)
+    with sequence_parallel_scope(mesh):
+        out_scoped = np.asarray(ln(x))
+    np.testing.assert_allclose(out_scoped, out_plain, atol=1e-5)
