@@ -301,3 +301,31 @@ def test_spark_model_sequence_parallel_lm_2d_targets(spark_context):
     assert np.isfinite(h["loss"]).all()
     assert h["loss"][-1] < h["loss"][0], h
     assert "accuracy" in h  # compiled metrics ride the 2-D-target path
+
+
+def test_ring_mha_joint_batch_head_tiling():
+    """r5 round sweep: when neither batch nor heads tile the data axis
+    alone but their product does (b=2, h=2, dp=4), ring_mha keeps the
+    merged batch×heads tiling (model-axis-free, so no remat cliff)
+    instead of replicating — and stays exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.ops.flash_attention import attention_reference
+    from elephas_tpu.parallel.sequence import (
+        dp_sp_mesh, ring_mha, sequence_parallel_scope,
+    )
+
+    rng = np.random.default_rng(0)
+    b, h, s, d = 2, 2, 64, 16
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.normal(size=(b, h, s, d)).astype(np.float32)
+    )
+    q, k, v = mk(), mk(), mk()
+    mesh = dp_sp_mesh(2, data_parallel=4)  # data=4: b%4!=0, h%4!=0
+    with sequence_parallel_scope(mesh):
+        out = ring_mha(q, k, v, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
