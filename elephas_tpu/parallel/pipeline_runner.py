@@ -32,12 +32,17 @@ microbatches over the stage mesh (weights stay depth-sharded), and
 ``evaluate`` aggregates the compiled per-sample loss + metric states
 over the gathered predictions — no device ever holds the full model.
 
-The training history carries the compiled metrics too (r4): the train
-step returns the last stage's predictions as a gradient aux, and keras
-metric states accumulate on HOST from them — nothing lands on the
-ring's critical path. (The streamed ``fit_stream`` path stays
-loss-only.) ``fit(validation_split=...)`` adds per-epoch ``val_*``
-metrics through the ring evaluator.
+The training history carries the compiled metrics too — ON DEVICE
+(r5, superseding the r4 host-side design): keras metric states
+accumulate inside the jitted pipeline step on the last stage's
+predictions and cross to host once per epoch, staged and streamed fits
+alike. ``fit(validation_split=...)`` adds per-epoch ``val_*`` metrics
+through the ring evaluator.
+
+PP×TP (r5): ``model_parallel`` width-shards each stage Megatron-style
+inside the ring (see ``_plan_stage_tp``), and causal LMs decode
+THROUGH the ring with weights depth-sharded (:meth:`PipelineRunner.
+generate`).
 """
 
 from __future__ import annotations
