@@ -18,7 +18,10 @@ from elephas_tpu.models.transformer import (
     transformer_classifier,
     transformer_lm,
 )
-from elephas_tpu.models.switch import switch_transformer_classifier
+from elephas_tpu.models.switch import (
+    switch_transformer_classifier,
+    switch_transformer_lm,
+)
 
 __all__ = [
     "mnist_mlp",
@@ -30,6 +33,7 @@ __all__ = [
     "transformer_lm",
     "generate",
     "switch_transformer_classifier",
+    "switch_transformer_lm",
     "MoeFFN",
     "FlashMHA",
     "FusedLayerNorm",

@@ -145,6 +145,101 @@ def __getattr__(name):
     raise AttributeError(name)
 
 
+def _switch_block(x, name, num_heads, head_dim, num_experts,
+                  expert_hidden, k, capacity_factor, aux_weight,
+                  dropout, L, FlashMHA, MoeFFN, causal=False,
+                  rope=False):
+    """One Switch block (pre-LN attention + routed-expert FFN) —
+    shared by the classifier and the causal LM."""
+    h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln1")(x)
+    h = FlashMHA(
+        num_heads, head_dim, causal=causal, rope=rope,
+        name=f"{name}_attn",
+    )(h)
+    if dropout > 0:
+        h = L.Dropout(dropout, name=f"{name}_drop1")(h)
+    x = L.Add(name=f"{name}_res1")([x, h])
+    h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln2")(x)
+    h = MoeFFN(
+        num_experts,
+        expert_hidden,
+        k=k,
+        capacity_factor=capacity_factor,
+        aux_weight=aux_weight,
+        name=f"{name}_moe",
+    )(h)
+    if dropout > 0:
+        h = L.Dropout(dropout, name=f"{name}_drop2")(h)
+    return L.Add(name=f"{name}_res2")([x, h])
+
+
+def switch_transformer_lm(
+    vocab_size: int = 20000,
+    maxlen: int = 128,
+    d_model: int = 128,
+    num_heads: int = 4,
+    num_layers: int = 2,
+    num_experts: int = 4,
+    expert_hidden: int | None = None,
+    k: int = 2,
+    capacity_factor: float = 1.5,
+    aux_weight: float = 1e-2,
+    dropout: float = 0.0,
+    lr: float = 1e-3,
+    seed: int = 0,
+    rope: bool = False,
+):
+    """Causal decoder LM with MoE FFN blocks (Switch-style) — the
+    sparse counterpart of
+    :func:`~elephas_tpu.models.transformer.transformer_lm` (r5; the
+    reference has neither LMs nor MoE — TPU-native extension).
+
+    Composes with the whole surface: trains through ``SparkModel``
+    (experts shard over the model axis under ``model_parallel`` — the
+    planner's ``expert_w*`` rules), and decodes through
+    ``models.generate`` including the KV-cache graph replay (MoE
+    routing is token-local, so the per-token replay is exact math).
+    Routing CAPACITY note: expert capacity is computed from the tokens
+    present in the program — the full-recompute decode routes all
+    ``B·maxlen`` positions, the cached decode routes ``B`` per step —
+    so capacity-DROPPED tokens can differ between the two paths; with
+    enough capacity (``k·capacity_factor ≥ num_experts``) nothing
+    drops and the paths agree exactly.
+    """
+    import keras
+
+    from elephas_tpu.models.transformer import (
+        _flash_mha_layer, _positions,
+    )
+
+    keras.utils.set_random_seed(seed)
+    L = keras.layers
+    FlashMHA = _flash_mha_layer()
+    MoeFFN = _moe_ffn_layer()
+    head_dim = d_model // num_heads
+    expert_hidden = expert_hidden or 4 * d_model
+
+    inputs = keras.Input((maxlen,), dtype="int32")
+    x = L.Embedding(vocab_size, d_model, name="tok_embed")(inputs)
+    if not rope:
+        x = x + _positions(maxlen, d_model)[None]
+    for b in range(num_layers):
+        x = _switch_block(
+            x, f"blk{b}", num_heads, head_dim, num_experts,
+            expert_hidden, k, capacity_factor, aux_weight, dropout, L,
+            FlashMHA, MoeFFN, causal=True, rope=rope,
+        )
+    x = L.LayerNormalization(epsilon=1e-6, name="final_ln")(x)
+    outputs = L.Dense(vocab_size, name="lm_head", dtype="float32")(x)
+    model = keras.Model(inputs, outputs, name="switch_transformer_lm")
+    model.compile(
+        optimizer=keras.optimizers.Adam(lr),
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"],
+    )
+    return model
+
+
 def switch_transformer_classifier(
     vocab_size: int = 20000,
     maxlen: int = 128,
@@ -183,24 +278,11 @@ def switch_transformer_classifier(
     x = L.Embedding(vocab_size, d_model, name="tok_embed")(inputs)
     x = x + _positions(maxlen, d_model)[None]
     for b in range(num_layers):
-        name = f"blk{b}"
-        h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln1")(x)
-        h = FlashMHA(num_heads, head_dim, name=f"{name}_attn")(h)
-        if dropout > 0:
-            h = L.Dropout(dropout, name=f"{name}_drop1")(h)
-        x = L.Add(name=f"{name}_res1")([x, h])
-        h = L.LayerNormalization(epsilon=1e-6, name=f"{name}_ln2")(x)
-        h = MoeFFN(
-            num_experts,
-            expert_hidden,
-            k=k,
-            capacity_factor=capacity_factor,
-            aux_weight=aux_weight,
-            name=f"{name}_moe",
-        )(h)
-        if dropout > 0:
-            h = L.Dropout(dropout, name=f"{name}_drop2")(h)
-        x = L.Add(name=f"{name}_res2")([x, h])
+        x = _switch_block(
+            x, f"blk{b}", num_heads, head_dim, num_experts,
+            expert_hidden, k, capacity_factor, aux_weight, dropout, L,
+            FlashMHA, MoeFFN,
+        )
     x = L.LayerNormalization(epsilon=1e-6, name="final_ln")(x)
     x = L.GlobalAveragePooling1D(name="pool")(x)
     activation = "sigmoid" if num_classes == 1 else "softmax"
