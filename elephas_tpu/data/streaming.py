@@ -84,6 +84,21 @@ class ShardedStream:
     def num_blocks(self) -> int:
         return math.ceil(self.steps / self.block_steps)
 
+    def step_valid_counts(self, step: int) -> np.ndarray:
+        """Per-worker count of REAL (non-wrap-padded) rows at ``step``
+        — ``[num_workers]`` ints in ``[0, batch_size]``.
+
+        Worker ``w`` owns ``counts[w]`` rows; positions past them in
+        its step stream are wrap-pad duplicates. Metric-exact consumers
+        (``GPipeTrainer.fit_stream``) zero-weight those duplicates so
+        streamed and staged fits report identical epoch metrics
+        (ADVICE r5); the loss keeps counting them at full weight, the
+        documented staged-path semantics."""
+        lo = step * self.batch_size
+        return np.clip(
+            np.asarray(self.counts) - lo, 0, self.batch_size
+        ).astype(np.int64)
+
     def _gather_rows(self, source, w: int, step_lo: int, step_hi: int):
         """Rows for worker ``w``, steps ``[step_lo, step_hi)``, wrap-padded
         within the worker's own range — only this chunk materializes."""

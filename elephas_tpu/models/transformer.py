@@ -408,7 +408,18 @@ def _sample_logits(logits, key, temperature: float, top_k, top_p=None):
 
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / temperature
+    scaled = _filter_logits(logits / temperature, top_k, top_p)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
+def _filter_logits(scaled, top_k, top_p):
+    """top-k / top-p (nucleus) truncation of ``[B, V]`` scaled logits —
+    ONE implementation shared by the scalar-temperature sampler above
+    and the serving engine's vector-temperature sampler, so the two
+    paths cannot drift apart (their parity is a documented contract)."""
+    import jax
+    import jax.numpy as jnp
+
     if top_k is not None:
         kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)][:, None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
@@ -425,7 +436,7 @@ def _sample_logits(logits, key, temperature: float, top_k, top_p=None):
             jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
         )
         scaled = jnp.where(scaled < kept_min, -jnp.inf, scaled)
-    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return scaled
 
 
 def _mesh_fingerprint(mesh, batch_axes, model_axis):
@@ -458,6 +469,17 @@ def _cache_insert(cache: dict, key, value, bound: int = 16):
     cache[key] = value
     while len(cache) > bound:
         cache.pop(next(iter(cache)))
+
+
+def _cache_get(cache: dict, key):
+    """Fetch AND refresh recency: the hit re-inserts at the dict's end,
+    so :func:`_cache_insert`'s evict-oldest approximates LRU instead of
+    FIFO — a hot decode config inserted early is no longer silently
+    evicted (and recompiled) once 16 newer configs appear (ADVICE r5)."""
+    value = cache.get(key)
+    if value is not None:
+        cache[key] = cache.pop(key)
+    return value
 
 
 def _finish_decode(model, run, wargs, tokens0, key, mesh, batch_axes,
@@ -622,7 +644,7 @@ def generate(
         bt, p, steps, float(temperature), top_k, top_p,
         _mesh_fingerprint(mesh, batch_axes, model_axis),
     )
-    run = cache.get(cache_key)
+    run = _cache_get(cache, cache_key)
     if run is None:
 
         @jax.jit
@@ -658,6 +680,147 @@ def generate(
         model, run, (tv, ntv), tokens0, jax.random.PRNGKey(seed),
         mesh, batch_axes, b, p + steps,
     )
+
+
+
+def validate_token_decode_model(model, what: str = "kv_cache decode",
+                                hint: str = "use kv_cache=False",
+                                allow_stock: bool = True):
+    """Compatibility gate for token-at-a-time cached decode, shared by
+    ``generate(kv_cache=True)`` and the serving engine
+    (:mod:`elephas_tpu.serving`): the model must be a single-input
+    functional graph of causal attention layers plus token-local
+    layers, computed in float32, with no weight-tied or nested
+    attention call sites. Returns ``(flash_layers, stock_mha_layers,
+    gqa_layers)``; raises ``ValueError`` (messages prefixed ``what``,
+    suffixed ``hint``) otherwise. ``allow_stock=False`` additionally
+    rejects stock keras MultiHeadAttention/GQA layers (callers whose
+    decode handlers only replay ``FlashMHA`` math)."""
+    import keras
+
+    FlashMHA = _flash_mha_layer()
+
+    if not hasattr(model, "_run_through_graph") or len(model.inputs) != 1:
+        raise ValueError(
+            f"{what} needs a single-input functional model; {hint} "
+            f"for this architecture"
+        )
+    flash_layers = [
+        l for l in model._flatten_layers() if isinstance(l, FlashMHA)
+    ]
+    gqa_cls = getattr(
+        keras.layers, "GroupQueryAttention", None
+    ) or getattr(keras.layers, "GroupedQueryAttention", None)
+
+    def _stock_layers_of(base):
+        if base is None:
+            return []
+        found = []
+        for l in model._flatten_layers():
+            if not isinstance(l, base):
+                continue
+            if not allow_stock:
+                raise ValueError(
+                    f"{what} replays FlashMHA attention only, but "
+                    f"{l.name!r} is a stock {base.__name__}; {hint}"
+                )
+            # the decode handler recomputes STOCK attention math from
+            # the EinsumDense kernels; a subclass overriding call /
+            # _compute_attention (RoPE, ALiBi, soft-caps...) would
+            # silently decode different tokens — reject with guidance
+            # (code-review r4)
+            if (
+                type(l).call is not base.call
+                or type(l)._compute_attention is not base._compute_attention
+            ):
+                raise ValueError(
+                    f"{what} replays stock {base.__name__} math, "
+                    f"but {l.name!r} is a customized subclass "
+                    f"({type(l).__name__}); {hint}"
+                )
+            if len(l._output_dense.kernel.shape) != 3:
+                raise ValueError(
+                    f"{what}: {l.name!r} has a non-default "
+                    f"output_shape (rank-"
+                    f"{len(l._output_dense.kernel.shape)} output "
+                    f"kernel); {hint}"
+                )
+            found.append(l)
+        return found
+
+    stock_mha_layers = _stock_layers_of(keras.layers.MultiHeadAttention)
+    gqa_layers = _stock_layers_of(gqa_cls)
+    if not flash_layers and not stock_mha_layers and not gqa_layers:
+        raise ValueError(
+            f"{what} needs at least one attention layer (FlashMHA"
+            + (", keras MultiHeadAttention, or GroupQueryAttention"
+               if allow_stock else "")
+            + f" — the cache lives there); {hint}"
+        )
+    for l in flash_layers:
+        if not l.causal:
+            raise ValueError(
+                f"{what} is causal by construction, but FlashMHA "
+                f"layer {l.name!r} has causal=False; {hint}"
+            )
+    # count call sites within THIS model's graph only — inbound nodes
+    # accumulate across every symbolic call a layer ever received, so a
+    # layer also referenced by some other Model would be spuriously
+    # rejected by a global count (code-review r4)
+    calls_here: dict[int, int] = {}
+    nodes_by_depth = getattr(model, "_nodes_by_depth", None)
+    if nodes_by_depth is None:  # fall back to the (global) node count
+        for l in flash_layers + stock_mha_layers + gqa_layers:
+            calls_here[id(l)] = len(l._inbound_nodes)
+    else:
+        for depth_nodes in nodes_by_depth.values():
+            for node in depth_nodes:
+                op = getattr(node, "operation", None)
+                if op is not None:
+                    calls_here[id(op)] = calls_here.get(id(op), 0) + 1
+    for l in flash_layers + stock_mha_layers + gqa_layers:
+        n_calls = calls_here.get(id(l), 0)
+        if n_calls > 1:
+            # weight-tied reuse (ALBERT-style): every call site would
+            # share ONE name-keyed cache and clobber the others' K/V
+            raise ValueError(
+                f"{what} keys K/V caches by layer, but "
+                f"{l.name!r} is called at {n_calls} graph "
+                f"nodes (weight tying) — the call sites would corrupt "
+                f"each other's cache; {hint}"
+            )
+        if n_calls == 0 and nodes_by_depth is not None:
+            # reachable only through a NESTED sub-Model's graph: the
+            # decode handler would never intercept it (the replay calls
+            # the inner Model as one opaque layer) — reject with
+            # guidance instead of dying mid-trace (code-review r4)
+            raise ValueError(
+                f"{what}: attention layer {l.name!r} lives "
+                f"inside a nested sub-Model — the token-by-token replay "
+                f"only walks the top-level graph; flatten the model or "
+                f"{hint}"
+            )
+    _SEQ_MIXING = (
+        keras.layers.GlobalAveragePooling1D, keras.layers.AveragePooling1D,
+        keras.layers.MaxPooling1D, keras.layers.Conv1D, keras.layers.RNN,
+        keras.layers.Flatten,
+    )
+    for l in model._flatten_layers():
+        if isinstance(l, _SEQ_MIXING):
+            raise ValueError(
+                f"{what} replays the graph one token at a time; "
+                f"layer {l.name!r} ({type(l).__name__}) mixes the "
+                f"sequence axis — {hint}"
+            )
+    compute_dtype = getattr(model.dtype_policy, "compute_dtype", "float32")
+    if compute_dtype != "float32":
+        raise ValueError(
+            f"{what} computes in float32, which would diverge "
+            f"from this model's {compute_dtype} forward (argmax flips "
+            f"where top logits are close) — {hint} for "
+            f"mixed-precision models"
+        )
+    return flash_layers, stock_mha_layers, gqa_layers
 
 
 def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
@@ -701,120 +864,12 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
 
     FlashMHA = _flash_mha_layer()
 
-    if not hasattr(model, "_run_through_graph") or len(model.inputs) != 1:
-        raise ValueError(
-            "kv_cache=True needs a single-input functional model; use "
-            "kv_cache=False for this architecture"
-        )
-    flash_layers = [
-        l for l in model._flatten_layers() if isinstance(l, FlashMHA)
-    ]
+    flash_layers, stock_mha_layers, gqa_layers = validate_token_decode_model(
+        model, what="kv_cache decode", hint="use kv_cache=False"
+    )
     gqa_cls = getattr(
         keras.layers, "GroupQueryAttention", None
     ) or getattr(keras.layers, "GroupedQueryAttention", None)
-
-    def _stock_layers_of(base):
-        if base is None:
-            return []
-        found = []
-        for l in model._flatten_layers():
-            if not isinstance(l, base):
-                continue
-            # the decode handler recomputes STOCK attention math from
-            # the EinsumDense kernels; a subclass overriding call /
-            # _compute_attention (RoPE, ALiBi, soft-caps...) would
-            # silently decode different tokens — reject with guidance
-            # (code-review r4)
-            if (
-                type(l).call is not base.call
-                or type(l)._compute_attention is not base._compute_attention
-            ):
-                raise ValueError(
-                    f"kv_cache decode replays stock {base.__name__} math, "
-                    f"but {l.name!r} is a customized subclass "
-                    f"({type(l).__name__}); use kv_cache=False"
-                )
-            if len(l._output_dense.kernel.shape) != 3:
-                raise ValueError(
-                    f"kv_cache decode: {l.name!r} has a non-default "
-                    f"output_shape (rank-"
-                    f"{len(l._output_dense.kernel.shape)} output "
-                    f"kernel); use kv_cache=False"
-                )
-            found.append(l)
-        return found
-
-    stock_mha_layers = _stock_layers_of(keras.layers.MultiHeadAttention)
-    gqa_layers = _stock_layers_of(gqa_cls)
-    if not flash_layers and not stock_mha_layers and not gqa_layers:
-        raise ValueError(
-            "kv_cache=True needs at least one attention layer (FlashMHA, "
-            "keras MultiHeadAttention, or GroupQueryAttention — the "
-            "cache lives there); use kv_cache=False"
-        )
-    for l in flash_layers:
-        if not l.causal:
-            raise ValueError(
-                f"kv_cache decode is causal by construction, but FlashMHA "
-                f"layer {l.name!r} has causal=False; use kv_cache=False"
-            )
-    # count call sites within THIS model's graph only — inbound nodes
-    # accumulate across every symbolic call a layer ever received, so a
-    # layer also referenced by some other Model would be spuriously
-    # rejected by a global count (code-review r4)
-    calls_here: dict[int, int] = {}
-    nodes_by_depth = getattr(model, "_nodes_by_depth", None)
-    if nodes_by_depth is None:  # fall back to the (global) node count
-        for l in flash_layers + stock_mha_layers + gqa_layers:
-            calls_here[id(l)] = len(l._inbound_nodes)
-    else:
-        for depth_nodes in nodes_by_depth.values():
-            for node in depth_nodes:
-                op = getattr(node, "operation", None)
-                if op is not None:
-                    calls_here[id(op)] = calls_here.get(id(op), 0) + 1
-    for l in flash_layers + stock_mha_layers + gqa_layers:
-        n_calls = calls_here.get(id(l), 0)
-        if n_calls > 1:
-            # weight-tied reuse (ALBERT-style): every call site would
-            # share ONE name-keyed cache and clobber the others' K/V
-            raise ValueError(
-                f"kv_cache decode keys K/V caches by layer, but "
-                f"{l.name!r} is called at {n_calls} graph "
-                f"nodes (weight tying) — the call sites would corrupt "
-                f"each other's cache; use kv_cache=False"
-            )
-        if n_calls == 0 and nodes_by_depth is not None:
-            # reachable only through a NESTED sub-Model's graph: the
-            # decode handler would never intercept it (the replay calls
-            # the inner Model as one opaque layer) — reject with
-            # guidance instead of dying mid-trace (code-review r4)
-            raise ValueError(
-                f"kv_cache decode: attention layer {l.name!r} lives "
-                f"inside a nested sub-Model — the token-by-token replay "
-                f"only walks the top-level graph; flatten the model or "
-                f"use kv_cache=False"
-            )
-    _SEQ_MIXING = (
-        keras.layers.GlobalAveragePooling1D, keras.layers.AveragePooling1D,
-        keras.layers.MaxPooling1D, keras.layers.Conv1D, keras.layers.RNN,
-        keras.layers.Flatten,
-    )
-    for l in model._flatten_layers():
-        if isinstance(l, _SEQ_MIXING):
-            raise ValueError(
-                f"kv_cache decode replays the graph one token at a time; "
-                f"layer {l.name!r} ({type(l).__name__}) mixes the "
-                f"sequence axis — use kv_cache=False"
-            )
-    compute_dtype = getattr(model.dtype_policy, "compute_dtype", "float32")
-    if compute_dtype != "float32":
-        raise ValueError(
-            f"kv_cache decode computes in float32, which would diverge "
-            f"from this model's {compute_dtype} forward (argmax flips "
-            f"where top logits are close) — use kv_cache=False for "
-            f"mixed-precision models"
-        )
 
     maxlen = tokens0.shape[1]
     total = p + steps
@@ -857,7 +912,7 @@ def _generate_cached(model, tokens0, b, p, steps, temperature, top_k,
         "kv", b, p, steps, float(temperature), top_k, top_p,
         _mesh_fingerprint(mesh, batch_axes, model_axis),
     )
-    run = cache.get(cache_key)
+    run = _cache_get(cache, cache_key)
     if run is None:
 
         def _slice_seq(a):

@@ -37,7 +37,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from elephas_tpu.parallel.mesh import host_read, put_global
+from elephas_tpu.parallel.mesh import (
+    axis_size_compat,
+    host_read,
+    put_global,
+    shard_map_compat,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -55,7 +60,7 @@ def gpipe(stage_fn, stage_params, x_microbatches, axis_name: str):
     ONLY (zeros elsewhere) — the caller slices the last stage's shard
     out instead of paying an all-reduce broadcast of whole activations.
     """
-    s = jax.lax.axis_size(axis_name)
+    s = axis_size_compat(axis_name)
     stage = jax.lax.axis_index(axis_name)
     m = x_microbatches.shape[0]
     ticks = m + s - 1
@@ -107,12 +112,12 @@ def gpipe_sharded(
         out = gpipe(stage_fn, params, xm, axis_name)
         return out[None]  # leading per-stage axis
 
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         fn,
         mesh=mesh,
         in_specs=(P(axis_name), P()),
         out_specs=P(axis_name),
-        check_vma=False,
+        check=False,
     )
     out = sharded(stacked_params, xm)[s - 1]
     return out.reshape((b,) + out.shape[2:])
@@ -481,7 +486,11 @@ class GPipeTrainer:
                         is_valid, out[:out_elems], jnp.zeros((out_elems,))
                     ).reshape(out_shape)
                     mb_loss = loss_fn(y_pred, ym[widx])
-                    loss_sum = loss_sum + jnp.where(is_valid, mb_loss, 0.0)
+                    # rank-1 accumulator on purpose: a RANK-0 scan-carry
+                    # residual breaks jax<=0.4.3x shard_map's transpose
+                    # (_SpecError on the scalar residual) when the
+                    # pipeline is differentiated through
+                    loss_sum = loss_sum + jnp.where(is_valid, mb_loss, 0.0)[None]
                 if collect_outputs:
                     updated = outputs.at[widx].set(out[:out_elems])
                     outputs = jnp.where(is_valid, updated, outputs)
@@ -494,10 +503,11 @@ class GPipeTrainer:
             outputs0 = jnp.zeros((M, out_elems), jnp.float32)
             (recv, outputs, loss_sum, st), _ = jax.lax.scan(
                 one_tick,
-                (recv0, outputs0, jnp.float32(0.0), stflat[0]),
+                (recv0, outputs0, jnp.zeros((1,), jnp.float32),
+                 stflat[0]),
                 jnp.arange(ticks),
             )
-            loss = jax.lax.psum(loss_sum, axis) / M
+            loss = jax.lax.psum(loss_sum[0], axis) / M
             if self.dp > 1:
                 # each data replica's loss is the mean over its local
                 # rows; the global mean averages the replicas (equal
@@ -515,12 +525,12 @@ class GPipeTrainer:
         param_spec = (
             P(self.axis, self.model_axis) if self.mp > 1 else P(self.axis)
         )
-        return jax.shard_map(
+        return shard_map_compat(
             per_device,
             mesh=self.mesh,
             in_specs=(param_spec, P(self.axis), self._mb_spec, self._mb_spec),
             out_specs=(P(), out_mb_spec, P(self.axis)),
-            check_vma=False,
+            check=False,
         )
 
     def _build_train_step(self, metric_update=None, mvs_example=None):
@@ -763,8 +773,10 @@ class GPipeTrainer:
         ``metric_state`` / ``metric_update`` / ``on_epoch_metrics``
         contract as :meth:`fit` — states accumulate on device through
         every streamed block and cross to host once per epoch.
-        Stream-internal wrap-pad rows count at full weight, like the
-        streamed loss.
+        Stream-internal wrap-pad rows are zero-weighted in the METRICS
+        via the stream's valid-row counts (ADVICE r5 — streamed and
+        staged fits report identical epoch metrics); the loss keeps
+        counting them at full weight, like the staged path.
         """
         from elephas_tpu.data.streaming import prefetch_blocks
 
@@ -797,22 +809,47 @@ class GPipeTrainer:
         collect = metric_update is not None
         train_step = self._get_train_step(metric_update, metric_state)
         mvs = None
-        sw_dev = None
+        sw_full = None
+        sw_cache: dict[tuple, object] = {}
         if collect:
             mvs = jax.tree.map(
                 lambda l: put_global(np.asarray(l), self._rep_sh),
                 metric_state,
             )
-            # streamed rows all count (the stream wrap-pads internally,
-            # like the loss); ONE device-resident all-ones weight buffer
-            # serves every step — no per-step upload (code-review r5)
-            sw_dev = put_global(
+            # metric weights zero the stream-internal wrap-pad rows so
+            # streamed and staged fits report IDENTICAL epoch metrics
+            # (ADVICE r5 — the loss still counts pads at full weight,
+            # the documented staged-path semantics). Only a handful of
+            # distinct masks exist (all-ones plus each shard-tail
+            # pattern); each stages ONCE and is reused every epoch —
+            # no per-step upload (code-review r5)
+            sw_full = put_global(
                 np.ones((M, need // M), np.float32), self._mb_sh
             )
+
+        def _sw_for(gs: int):
+            counts = stream.step_valid_counts(gs)
+            if (counts >= stream.batch_size).all():
+                return sw_full
+            key = tuple(int(c) for c in counts)
+            staged = sw_cache.get(key)
+            if staged is None:
+                # [dp, B] row validity flattens worker-major, exactly
+                # like the step's x rows, then microbatches like them
+                mask = (
+                    np.arange(stream.batch_size)[None, :]
+                    < counts[:, None]
+                ).astype(np.float32)
+                staged = put_global(
+                    mask.reshape(M, need // M), self._mb_sh
+                )
+                sw_cache[key] = staged
+            return staged
 
         history: dict[str, list[float]] = {"loss": []}
         for epoch in range(epochs):
             losses = []
+            gs = 0  # global step index within the epoch
             for xb, yb, steps in prefetch_blocks(stream.blocks()):
                 for t in range(steps):
                     xt, yt = xb[:, t], yb[:, t]  # [dp, B, ...]
@@ -829,11 +866,12 @@ class GPipeTrainer:
                     )
                     if collect:
                         (self.params, self.state, self.opt_state, loss,
-                         mvs) = train_step(*args, mvs, sw_dev)
+                         mvs) = train_step(*args, mvs, _sw_for(gs))
                     else:
                         (self.params, self.state, self.opt_state,
                          loss) = train_step(*args)
                     losses.append(loss)
+                    gs += 1
             if collect:
                 mvs = self._drain_metrics(
                     mvs, metric_state, on_epoch_metrics

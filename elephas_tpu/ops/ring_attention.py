@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from elephas_tpu.ops.flash_attention import _flash_forward, NEG_INF
+from elephas_tpu.parallel.mesh import axis_size_compat, shard_map_compat
 
 
 def _merge(o1, lse1, o2, lse2):
@@ -42,7 +43,7 @@ def _merge(o1, lse1, o2, lse2):
 
 def _ring_forward(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
     """Returns (out, lse) for the local shard; kv chunks rotate the ring."""
-    w = jax.lax.axis_size(axis_name)
+    w = axis_size_compat(axis_name)
     me = jax.lax.axis_index(axis_name)
     bh, s_local, d = q.shape
     f32 = jnp.float32
@@ -120,7 +121,7 @@ def _chunk_grads(q, kc, vc, g, lse, delta, scale, mask):
 def _ring_backward(axis_name, causal, scale, block_q, block_k, interpret,
                    residuals, g):
     q, k, v, out, lse = residuals
-    w = jax.lax.axis_size(axis_name)
+    w = axis_size_compat(axis_name)
     me = jax.lax.axis_index(axis_name)
     bh, s_local, d = q.shape
     f32 = jnp.float32
@@ -233,8 +234,8 @@ def ring_attention_sharded(
         interpret=interpret,
     )
     spec = P(None, axis_name, None)
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        check=False,
     )
     return sharded(q, k, v)

@@ -35,6 +35,8 @@ import threading
 
 import jax
 import numpy as np
+
+from elephas_tpu.parallel.mesh import shard_map_compat
 from jax.sharding import Mesh, PartitionSpec as P
 
 from elephas_tpu.parallel.tensor import ShardedTrainer, TensorParallelRunner
@@ -180,9 +182,9 @@ def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
             ulysses_attention, axis_name=scope.seq_axis, causal=causal,
             scale=scale,
         )
-        return jax.shard_map(
+        return shard_map_compat(
             fn4, mesh=scope.mesh, in_specs=(spec4,) * 3, out_specs=spec4,
-            check_vma=False,
+            check=False,
         )(q, k, v)
     # batch shards over 'data' and heads over 'model' when they tile.
     # The q/k/v stay 4-D [B, H, S, D] through the shard_map boundary
@@ -217,9 +219,9 @@ def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
             ring_attention, axis_name=scope.seq_axis, causal=causal,
             scale=scale,
         )
-        sharded3 = jax.shard_map(
+        sharded3 = shard_map_compat(
             fn3, mesh=scope.mesh, in_specs=(spec,) * 3, out_specs=spec,
-            check_vma=False,
+            check=False,
         )
         out = sharded3(
             q.reshape(b * h, s, d), k.reshape(b * h, s, d),
@@ -257,9 +259,9 @@ def ring_mha(q, k, v, causal: bool = False, scale: float | None = None,
         )
         return out.reshape(bl, hl, sl, dl)
 
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         fn, mesh=scope.mesh, in_specs=(spec,) * 3, out_specs=spec,
-        check_vma=False,
+        check=False,
     )
     return sharded(q, k, v)
 

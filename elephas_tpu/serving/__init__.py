@@ -1,0 +1,30 @@
+"""Continuous-batching inference serving (ISSUE 1 tentpole).
+
+Every decode entry point before this subsystem was a one-shot,
+whole-batch call: all prompts start together, the batch stalls until
+its slowest sequence finishes, and every new ``(batch, length)`` shape
+risks a fresh XLA compile. This package converts that dead time into
+throughput the way modern LLM servers do (Orca-style iteration-level
+scheduling, vLLM-style slot/paged KV):
+
+- :mod:`elephas_tpu.serving.kv_cache` — a fixed slot arena of
+  per-layer K/V caches with per-slot write cursors, so sequences of
+  different lengths coexist inside ONE compiled decode step;
+- :mod:`elephas_tpu.serving.scheduler` — iteration-level admission of
+  queued requests into free slots, immediate reclamation on
+  EOS/max-tokens, and bucketed prompt padding that keeps the compiled
+  shape set small and fixed;
+- :mod:`elephas_tpu.serving.engine` — :class:`InferenceEngine`, the
+  host-side driver (surfaced as ``SparkModel.serve()``): submit
+  requests at any time, stream tokens back per request, run the same
+  fixed-shape jitted step for the life of the server.
+"""
+
+from elephas_tpu.serving.engine import InferenceEngine  # noqa: F401
+from elephas_tpu.serving.scheduler import (  # noqa: F401
+    Request,
+    Scheduler,
+    bucket_for,
+    default_buckets,
+)
+from elephas_tpu.serving.kv_cache import SlotKVCache  # noqa: F401

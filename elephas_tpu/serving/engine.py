@@ -1,0 +1,513 @@
+"""InferenceEngine — the continuous-batching serving loop.
+
+One engine wraps one causal LM (a ``transformer_lm``-style FlashMHA
+model) and serves any number of generation requests through two
+program FAMILIES, compiled once each and reused for the life of the
+server:
+
+- one **prefill** program per prompt-length bucket (a closed, fixed
+  ladder — :func:`~elephas_tpu.serving.scheduler.default_buckets`),
+  writing a whole prompt's K/V into a leased slot in a single
+  full-sequence forward;
+- ONE **decode step** over the whole slot arena, advancing every
+  in-flight sequence by one token at its own position (the vector
+  write-cursor in :mod:`~elephas_tpu.serving.kv_cache`).
+
+Each :meth:`InferenceEngine.step`: admit waiting requests into free
+slots (prefill each), run the decode step, read the sampled tokens,
+reclaim slots that hit EOS / their token budget. Requests can be
+submitted at ANY time — they join the next step's admission wave
+(iteration-level scheduling) — and finished slots free mid-flight, so
+short sequences never hold long ones hostage the way one-shot batch
+``generate()`` does.
+
+Mesh-aware like the one-shot path: under a DP mesh the slot axis
+shards over the batch axes; under TP the weights stay sharded through
+``stateless_call`` with the planner's layouts and the arena shards
+heads over the model axis. Every gang process must drive the engine
+with the identical submission sequence (the SPMD contract ``generate``
+already imposes); all read identical tokens.
+
+Weights ride as jit ARGUMENTS, uploaded once at construction —
+:meth:`refresh_weights` re-uploads after further training.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from elephas_tpu.serving.kv_cache import (
+    SlotKVCache,
+    prefill_forward,
+    token_decode_step,
+)
+from elephas_tpu.serving.scheduler import (
+    Request,
+    Scheduler,
+    default_buckets,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _sample_dynamic(logits, key, temps, top_k, top_p):
+    """Per-row sampling with a DYNAMIC temperature vector: rows with
+    ``temps <= 0`` take greedy argmax (bit-identical to the one-shot
+    path's temperature-0 branch), the rest temperature-scaled
+    categorical under the engine's static top_k/top_p filters (same
+    filter math as ``_sample_logits``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.models.transformer import _filter_logits
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = _filter_logits(
+        logits / jnp.maximum(temps, 1e-6)[:, None], top_k, top_p
+    )
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+class InferenceEngine:
+    """Continuous-batching server over a slot-based KV cache.
+
+    ``num_slots`` bounds concurrent in-flight sequences (rounded up to
+    the mesh's batch-axis product so the arena shards evenly);
+    ``buckets`` overrides the prompt-padding ladder; ``top_k`` /
+    ``top_p`` are engine-static sampling filters; per-request
+    ``temperature`` rides as data (0 = greedy).
+
+    PP ring decode is not integrated yet — construct via
+    ``SparkModel.serve()`` on a DP/TP mesh, or directly on no mesh.
+    """
+
+    def __init__(self, model, num_slots: int = 8, mesh=None,
+                 batch_axes=("data",), model_axis=None, rules=None,
+                 top_k: int | None = None, top_p: float | None = None,
+                 seed: int = 0, buckets=None, steps_per_sync: int = 1):
+        import jax
+        import jax.numpy as jnp
+
+        from elephas_tpu.models.transformer import (
+            validate_token_decode_model,
+        )
+
+        flash_layers, _stock, _gqa = validate_token_decode_model(
+            model,
+            what="the serving engine",
+            hint="use one-shot generate()",
+            allow_stock=False,
+        )
+        self.model = model
+        self.maxlen = int(model.inputs[0].shape[1])
+        self.vocab = int(model.outputs[0].shape[-1])
+        self.top_k = top_k
+        self.top_p = top_p
+        if top_k is not None and not 0 < int(top_k) <= self.vocab:
+            raise ValueError(
+                f"top_k={top_k} outside (0, vocab={self.vocab}]"
+            )
+        if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p={top_p} outside (0, 1]")
+
+        self.mesh = mesh
+        if isinstance(batch_axes, str):
+            batch_axes = (batch_axes,)
+        self.batch_axes = tuple(batch_axes)
+        self.model_axis = model_axis
+        if mesh is not None:
+            missing = [a for a in self.batch_axes if a not in mesh.shape]
+            if missing:
+                raise ValueError(
+                    f"batch_axes {missing} not in mesh axes "
+                    f"{tuple(mesh.shape)}"
+                )
+            dp = int(
+                np.prod([mesh.shape[a] for a in self.batch_axes])
+            )
+            if num_slots % dp:
+                rounded = num_slots + (-num_slots) % dp
+                logger.info(
+                    "rounding num_slots %d -> %d (multiple of the "
+                    "batch-axis product %d)", num_slots, rounded, dp,
+                )
+                num_slots = rounded
+        if num_slots < 1:
+            raise ValueError(f"num_slots={num_slots} < 1")
+        self.num_slots = int(num_slots)
+
+        if buckets is not None:
+            buckets = tuple(int(b) for b in buckets)
+            bad = [b for b in buckets if not 0 < b <= self.maxlen]
+            if bad:
+                raise ValueError(
+                    f"buckets {bad} outside (0, maxlen={self.maxlen}] — "
+                    f"a bucket beyond maxlen would overflow the KV arena"
+                )
+
+        self.arena = SlotKVCache(
+            flash_layers, self.num_slots, self.maxlen,
+            mesh=mesh, batch_axes=self.batch_axes, model_axis=model_axis,
+        )
+        self.scheduler = Scheduler(
+            self.num_slots, buckets or default_buckets(self.maxlen)
+        )
+        self._rules = rules
+        self._seed = int(seed)
+        self.total_generated = 0
+        # completed requests, BOUNDED: a server alive for millions of
+        # requests must not grow host memory linearly — callers keep
+        # their own Request handles from submit(); this registry only
+        # feeds stats()/tests and evicts oldest past the bound
+        self.finished: dict[int, Request] = {}
+        self._finished_bound = 4096
+        self.finished_count = 0
+
+        maxlen, arena = self.maxlen, self.arena
+
+        def _constrain_all(caches):
+            heads = {name: h for name, h, _d in arena.specs}
+            return {
+                name: (
+                    arena.constrain(k, heads[name]),
+                    arena.constrain(v, heads[name]),
+                )
+                for name, (k, v) in caches.items()
+            }
+
+        def _vec(z):
+            if mesh is None:
+                return z
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.lax.with_sharding_constraint(
+                z, NamedSharding(mesh, P(self.batch_axes))
+            )
+
+        def init_state():
+            caches = arena.init()
+            lengths = _vec(jnp.zeros((self.num_slots,), jnp.int32))
+            last = _vec(jnp.zeros((self.num_slots,), jnp.int32))
+            temps = _vec(jnp.zeros((self.num_slots,), jnp.float32))
+            return caches, lengths, last, temps
+
+        def prefill(w, caches, lengths, last, temps, tokens_rows,
+                    p_lens, admit, new_temps, key):
+            logits, caches = prefill_forward(
+                model, w, tokens_rows, caches, admit, maxlen
+            )
+            caches = _constrain_all(caches)
+            # each row's next-token logits sit at its own prompt end —
+            # one-hot contraction over the bucket axis (exact select,
+            # and slot-local under the mesh unlike a per-row gather)
+            S = tokens_rows.shape[1]
+            at_end = (
+                (p_lens - 1)[:, None] == jnp.arange(S)[None, :]
+            ).astype(logits.dtype)
+            last_logits = jnp.einsum("bs,bsv->bv", at_end, logits)
+            key, sub = jax.random.split(key)
+            firsts = _sample_dynamic(
+                last_logits, sub, new_temps, self.top_k, self.top_p
+            )
+            lengths = _vec(jnp.where(admit, p_lens, lengths))
+            last = _vec(jnp.where(admit, firsts, last))
+            temps = _vec(jnp.where(admit, new_temps, temps))
+            return caches, lengths, last, temps, key, firsts
+
+        # multi-step scheduling (the vLLM/TensorRT-LLM trick): decode
+        # `steps_per_sync` tokens per dispatch inside ONE fori_loop, so
+        # program-launch + host-sync cost amortizes over the window.
+        # Scheduling decisions (admission, reclaim) then happen at
+        # window boundaries — k=1 is pure Orca iteration-level
+        # scheduling; larger k trades up to k-1 wasted positions on a
+        # mid-window finish for far fewer host round-trips. Greedy
+        # (temperature-0) tokens are identical across k; sampled
+        # streams match only while windows are fully consumed — a
+        # drain that abandons a window tail still advanced the key k
+        # times, so later temp>0 requests may sample differently than
+        # under k=1 (deterministic per (seed, k, schedule) either way).
+        k_window = max(1, int(steps_per_sync))
+        self.steps_per_sync = k_window
+
+        def decode(w, caches, lengths, last, temps, key):
+            def body(i, carry):
+                caches, lengths, last, key, toks = carry
+                positions = jnp.minimum(lengths, maxlen - 1)
+                logits, caches = token_decode_step(
+                    model, w, last, positions, caches, maxlen
+                )
+                caches = _constrain_all(caches)
+                key, sub = jax.random.split(key)
+                sampled = _sample_dynamic(
+                    logits, sub, temps, self.top_k, self.top_p
+                )
+                lengths = _vec(jnp.minimum(lengths + 1, maxlen))
+                toks = toks.at[i].set(sampled)
+                return caches, lengths, _vec(sampled), key, toks
+
+            toks0 = jnp.zeros((k_window, self.num_slots), jnp.int32)
+            caches, lengths, last, key, toks = jax.lax.fori_loop(
+                0, k_window, body, (caches, lengths, last, key, toks0)
+            )
+            return caches, lengths, last, key, toks
+
+        # the fixed program set: ONE decode window + one prefill per
+        # prompt bucket (p_lens/admit/new_temps ride as traced vectors,
+        # so only the bucket SHAPE triggers a compile)
+        self._init_jit = jax.jit(init_state)
+        self._prefill_jit = jax.jit(
+            prefill, donate_argnums=(1, 2, 3, 4, 9)
+        )  # args: w, caches, lengths, last, temps, rows, p_lens,
+        #         admit, new_temps, key
+        self._decode_jit = jax.jit(decode, donate_argnums=(1, 2, 3, 5))
+
+        self.refresh_weights()
+        self._caches, self._lengths, self._last, self._temps = (
+            self._init_jit()
+        )
+        self._key = self._stage(
+            np.asarray(jax.random.PRNGKey(self._seed))
+        )
+
+    # -- device staging ------------------------------------------------
+
+    def _stage(self, arr):
+        """Host value → device, replicated under the mesh (gang-safe)."""
+        import jax.numpy as jnp
+
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from elephas_tpu.parallel.mesh import put_global
+
+        return put_global(np.asarray(arr), NamedSharding(self.mesh, P()))
+
+    def _host(self, leaf) -> np.ndarray:
+        if self.mesh is None:
+            return np.asarray(leaf)
+        from elephas_tpu.parallel.mesh import host_read
+
+        return host_read(leaf, self.mesh)
+
+    def refresh_weights(self) -> None:
+        """(Re-)upload the model's weights — call after further
+        training; the compiled programs take them as arguments, so no
+        recompile happens."""
+        import jax.numpy as jnp
+
+        if self.mesh is None:
+            self._weights = {
+                v.path: jnp.asarray(v.value) for v in self.model.variables
+            }
+            return
+        from elephas_tpu.models.transformer import _decode_shardings
+        from elephas_tpu.parallel.mesh import put_global
+
+        var_sh = _decode_shardings(
+            list(self.model.variables), self.mesh, self.model_axis,
+            self._rules,
+        )
+        self._weights = {
+            v.path: put_global(np.asarray(v.value), s)
+            for v, s in zip(self.model.variables, var_sh)
+        }
+
+    # -- request API ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0, eos_id: int | None = None
+               ) -> Request:
+        """Queue one generation request (admitted at the next step —
+        submission is legal at any time, including mid-flight). Every
+        gang process must submit the identical sequence of requests."""
+        prompt = np.asarray(prompt).reshape(-1)
+        p = len(prompt)
+        if p < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens={max_new_tokens} < 1")
+        if p + max_new_tokens > self.maxlen:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the model's maxlen ({self.maxlen})"
+            )
+        if temperature < 0:
+            raise ValueError(f"temperature={temperature} < 0")
+        # fail HERE, not mid-flight in the prefill wave (where the
+        # request would already hold a leased slot): a custom bucket
+        # ladder may top out below the model's maxlen
+        self.scheduler.bucket_for(p)
+        req = self.scheduler.make_request(
+            prompt, max_new_tokens, temperature=temperature, eos_id=eos_id
+        )
+        req.submit_time = time.perf_counter()
+        self.scheduler.submit(req)
+        return req
+
+    def _emit(self, req: Request, token: int) -> bool:
+        """Record one generated token; reclaim + file the request when
+        it finished. Returns done."""
+        self.total_generated += 1
+        slot = req.slot
+        done = self.scheduler.on_token(slot, token)
+        if done:
+            req.finish_time = time.perf_counter()
+            self.scheduler.reclaim(slot)
+            self.finished_count += 1
+            self.finished[req.rid] = req
+            while len(self.finished) > self._finished_bound:
+                self.finished.pop(next(iter(self.finished)))
+        return done
+
+    def _stage_slots(self, arr):
+        """Host ``[num_slots, ...]`` value → device, slot axis over the
+        batch axes (gang-safe)."""
+        import jax.numpy as jnp
+
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from elephas_tpu.parallel.mesh import put_global
+
+        spec = (self.batch_axes,) + (None,) * (np.ndim(arr) - 1)
+        return put_global(
+            np.asarray(arr), NamedSharding(self.mesh, P(*spec))
+        )
+
+    def _prefill_wave(self, admitted: list[Request]) -> None:
+        """Prefill one admission wave: ONE program launch per prompt
+        bucket covers every request of that bucket in the wave."""
+        by_bucket: dict[int, list[Request]] = {}
+        for req in admitted:
+            b = self.scheduler.bucket_for(len(req.prompt))
+            by_bucket.setdefault(b, []).append(req)
+        for bucket in sorted(by_bucket):
+            reqs = by_bucket[bucket]
+            rows = np.zeros((self.num_slots, bucket), np.int32)
+            p_lens = np.ones((self.num_slots,), np.int32)
+            admit = np.zeros((self.num_slots,), bool)
+            new_temps = np.zeros((self.num_slots,), np.float32)
+            for req in reqs:
+                rows[req.slot, : len(req.prompt)] = req.prompt
+                p_lens[req.slot] = len(req.prompt)
+                admit[req.slot] = True
+                new_temps[req.slot] = req.temperature
+            (self._caches, self._lengths, self._last, self._temps,
+             self._key, firsts) = self._prefill_jit(
+                self._weights, self._caches, self._lengths, self._last,
+                self._temps, self._stage_slots(rows),
+                self._stage_slots(p_lens), self._stage_slots(admit),
+                self._stage_slots(new_temps), self._key,
+            )
+            toks = self._host(firsts)
+            for req in reqs:
+                self._emit(req, int(toks[req.slot]))
+
+    def step(self) -> list[tuple[Request, int, bool]]:
+        """One engine iteration: admission+prefill of waiting requests
+        into free slots, then one arena-wide decode window of
+        ``steps_per_sync`` steps. Returns ``(request, token, done)``
+        triples in generation order (a request can appear several
+        times: its prefill token plus one per window position); the
+        ``done`` flag is per-TOKEN — True only on a request's final
+        token, so stream consumers can stop at it without dropping
+        tokens."""
+        emitted: list[tuple[Request, int, bool]] = []
+        admitted = self.scheduler.admit()
+        if admitted:
+            self._prefill_wave(admitted)
+            # before any decode token, so req.done here is the prefill
+            # token's own flag
+            emitted.extend(
+                (req, req.tokens[-1], req.done) for req in admitted
+            )
+        if not self.scheduler.active:
+            return emitted
+        (self._caches, self._lengths, self._last, self._key,
+         window) = self._decode_jit(
+            self._weights, self._caches, self._lengths, self._last,
+            self._temps, self._key,
+        )
+        toks = self._host(window)  # [steps_per_sync, num_slots]
+        for i in range(self.steps_per_sync):
+            if not self.scheduler.active:
+                break  # window tail decoded garbage for empty slots
+            self.scheduler.note_step()
+            for slot, req in sorted(self.scheduler.active.items()):
+                done = self._emit(req, int(toks[i, slot]))
+                emitted.append((req, req.tokens[-1], done))
+        return emitted
+
+    def stream(self):
+        """Drive the engine until the queue drains, yielding
+        ``(request_id, token, done)`` as tokens land — the per-request
+        token stream. More requests may be submitted while consuming
+        (they join the next admission wave)."""
+        while self.scheduler.has_work:
+            for req, token, done in self.step():
+                yield req.rid, token, done
+
+    def run(self, requests=None) -> dict[int, np.ndarray]:
+        """Convenience batch driver: optionally submit ``requests``
+        (an iterable of ``(prompt, max_new_tokens)`` pairs or kwargs
+        dicts), drive the engine until idle, and return
+        ``{request_id: full token sequence (prompt + generated)}``."""
+        if requests is not None:
+            for r in requests:
+                if isinstance(r, dict):
+                    self.submit(**r)
+                else:
+                    prompt, max_new = r
+                    self.submit(prompt, max_new)
+        drained: dict[int, np.ndarray] = {}
+        while self.scheduler.has_work:
+            for req, _tok, done in self.step():
+                if done:
+                    drained[req.rid] = np.asarray(
+                        req.full_sequence, np.int32
+                    )
+        return drained
+
+    # -- introspection -------------------------------------------------
+
+    def compile_stats(self) -> dict:
+        """Compiled-program counts (the compile-count introspection
+        hook): after warmup ``decode_compiles`` must stay at 1 for the
+        server's whole life, and ``prefill_compiles`` is bounded by the
+        bucket ladder."""
+
+        def n(f):
+            try:
+                return int(f._cache_size())
+            except Exception:  # pragma: no cover - jax-version drift
+                return -1
+
+        return {
+            "decode_compiles": n(self._decode_jit),
+            "prefill_compiles": n(self._prefill_jit),
+            "buckets": tuple(self.scheduler.buckets),
+        }
+
+    def stats(self) -> dict:
+        """Serving counters for the bench: aggregate generated tokens,
+        decode steps, mean slot occupancy, and per-request latencies
+        (seconds) of finished requests."""
+        lat = [
+            r.finish_time - r.submit_time
+            for r in self.finished.values()
+            if r.finish_time is not None and r.submit_time is not None
+        ]
+        return {
+            "total_generated": self.total_generated,
+            "decode_steps": self.scheduler._steps,
+            "occupancy": self.scheduler.occupancy,
+            "latencies": lat,
+            "finished": self.finished_count,
+            "num_slots": self.num_slots,
+        }

@@ -1,0 +1,395 @@
+"""Slot-based KV cache arena + the token/prefill graph replays.
+
+The arena is a fixed ``[num_slots, max_len, heads, head_dim]`` pair of
+K/V buffers per attention layer — the serving analogue of
+``generate(kv_cache=True)``'s per-call caches, except slots outlive any
+single request: a slot is a lease, its **write cursor** (the per-slot
+position vector threaded through the decode step) marks how many
+tokens of the current occupant are cached, and reclaiming a slot is
+free (the next occupant's prefill simply overwrites from position 0;
+stale rows beyond the new prompt are never visible because causal
+decode only attends positions ``<= cursor`` and every such position is
+rewritten before the cursor reaches it).
+
+Sharded exactly like the mesh-aware decode path: the slot axis rides
+the batch axes, heads ride the model axis when they tile
+(:func:`SlotKVCache.constrain` mirrors ``_generate_cached``'s
+``_constrain_cache`` rules), so the arena of a TP-sharded model lives
+sharded for the server's whole lifetime.
+
+Two graph replays produce/consume the arena, both built on keras'
+``Function._run_through_graph`` node traversal (the mechanism proven
+by ``generate(kv_cache=True)``):
+
+- :func:`token_decode_step` — ONE token per slot, at per-slot
+  positions (a *vector* cursor — this is what lets sequences of
+  different lengths decode in the same compiled program; the one-shot
+  path only ever needed a scalar ``t``);
+- :func:`prefill_forward` — a whole (bucket-padded) prompt for one
+  slot as a single full-sequence forward, writing every position's K/V
+  into the slot row at once instead of token-by-token.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from elephas_tpu.models.transformer import (
+    _apply_rope,
+    _flash_mha_layer,
+    _rope_tables,
+)
+
+
+def _is_concrete(a):
+    import jax
+
+    return isinstance(a, np.ndarray) or (
+        isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer)
+    )
+
+
+def _squeeze_table(arr, maxlen):
+    """Collapse a recorded ``[1, ..., maxlen, D]`` broadcast table to
+    ``[maxlen, D]`` (the positional-table shape the graph records)."""
+    import jax.numpy as jnp
+
+    lead = arr.shape[:-2]
+    if any(int(d) != 1 for d in lead):
+        raise ValueError(
+            f"serving decode cannot replay a concrete graph constant of "
+            f"shape {arr.shape}: non-broadcast leading dims over the "
+            f"sequence axis"
+        )
+    return jnp.reshape(arr, (maxlen, arr.shape[-1]))
+
+
+def _rows_at_positions(table, positions):
+    """``table[positions]`` as a one-hot matmul: per-row dynamic
+    gathers on arrays whose batch axis is sharded over the mesh make
+    GSPMD emit collectives INSIDE the decode loop (measured ~15× step
+    cost on the CPU mesh); the one-hot contraction is slot-local and
+    bit-exact (each row sums exactly one 1.0·value against 0.0s)."""
+    import jax.numpy as jnp
+
+    onehot = (
+        positions[:, None] == jnp.arange(table.shape[0])[None, :]
+    )
+    if jnp.issubdtype(table.dtype, jnp.floating):
+        return onehot.astype(table.dtype) @ table
+    # integer/bool tables (e.g. a recorded position-ids arange): exact
+    # select-and-sum — the mask broadcasts [B, L, 1] against [1, L, D]
+    gathered = jnp.where(onehot[:, :, None], table[None], 0).sum(axis=1)
+    return gathered.astype(table.dtype)
+
+
+def _slice_seq_at_positions(a, positions, maxlen):
+    """Decode-time analogue of ``_generate_cached``'s ``_slice_seq``
+    with a VECTOR cursor: concrete arrays spanning the sequence axis
+    follow each slot's own position (``[.., maxlen, D]`` → ``[B, D]``
+    rows, ``[maxlen]`` → ``[B]``). Traced tensors pass through."""
+    import jax.numpy as jnp
+
+    if not _is_concrete(a):
+        return a
+    arr = jnp.asarray(a)
+    if arr.ndim >= 2 and arr.shape[-2] == maxlen:
+        return _rows_at_positions(_squeeze_table(arr, maxlen), positions)
+    if arr.ndim == 1 and arr.shape[0] == maxlen:
+        return _rows_at_positions(arr[:, None], positions)[:, 0]
+    return a
+
+
+def _slice_seq_prefix(a, s, maxlen):
+    """Prefill-time slice: concrete arrays spanning the sequence axis
+    truncate to the first ``s`` (bucket) positions."""
+    import jax.numpy as jnp
+
+    if not _is_concrete(a):
+        return a
+    arr = jnp.asarray(a)
+    if arr.ndim >= 2 and arr.shape[-2] == maxlen:
+        return arr[..., :s, :]
+    if arr.ndim == 1 and arr.shape[0] == maxlen:
+        return arr[:s]
+    return a
+
+
+class SlotKVCache:
+    """Specs + sharding rules for the slot arena of one model.
+
+    Holds only host-side metadata (layer names/head geometry and the
+    mesh layout); the arrays themselves are functional state threaded
+    through the engine's jitted steps — :meth:`init` builds the zeroed
+    arena, :meth:`constrain` pins a buffer's sharding inside a traced
+    program."""
+
+    def __init__(self, flash_layers, num_slots: int, max_len: int,
+                 mesh=None, batch_axes=("data",), model_axis=None):
+        self.specs = [
+            (l.name, int(l.num_heads), int(l.head_dim))
+            for l in flash_layers
+        ]
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.mesh = mesh
+        self.batch_axes = tuple(
+            (batch_axes,) if isinstance(batch_axes, str) else batch_axes
+        )
+        self.model_axis = model_axis
+
+    def nbytes(self) -> int:
+        """Host-side size estimate of the full (f32) arena."""
+        per_pos = sum(h * d for _, h, d in self.specs) * 2 * 4
+        return self.num_slots * self.max_len * per_pos
+
+    def constrain(self, z, heads: int):
+        """``[slots, S, H, Dh]`` buffers: slots over the batch axes,
+        heads over the model axis when they tile (same rule as the
+        one-shot mesh decode's ``_constrain_cache``)."""
+        if self.mesh is None:
+            return z
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ax = (
+            self.model_axis
+            if self.model_axis is not None
+            and self.mesh.shape.get(self.model_axis, 1) > 1
+            and heads % self.mesh.shape[self.model_axis] == 0
+            else None
+        )
+        return jax.lax.with_sharding_constraint(
+            z, NamedSharding(self.mesh, P(self.batch_axes, None, ax, None))
+        )
+
+    def init(self) -> dict:
+        """The zeroed arena: ``{layer_name: (k, v)}``, each
+        ``[num_slots, max_len, H, Dh]`` float32, sharded per
+        :meth:`constrain` (built under jit by the engine so the zeros
+        materialize directly in their sharded layout)."""
+        import jax.numpy as jnp
+
+        return {
+            name: (
+                self.constrain(
+                    jnp.zeros(
+                        (self.num_slots, self.max_len, h, d), jnp.float32
+                    ),
+                    h,
+                ),
+                self.constrain(
+                    jnp.zeros(
+                        (self.num_slots, self.max_len, h, d), jnp.float32
+                    ),
+                    h,
+                ),
+            )
+            for name, h, d in self.specs
+        }
+
+
+def token_decode_step(model, w, tok, positions, caches, maxlen):
+    """One decode step for the WHOLE arena: slot ``i`` consumes token
+    ``tok[i]`` at position ``positions[i]`` (its write cursor), writes
+    that position's K/V into its arena row, attends over positions
+    ``<= positions[i]``, and yields its next-token logits.
+
+    Same per-row math as ``_generate_cached``'s scalar-``t`` handler
+    (einsum strings and operation order kept identical so slot-decoded
+    tokens match one-shot ``generate()`` exactly at temperature 0) —
+    the only generalization is the vector cursor.
+
+    Returns ``(logits [num_slots, vocab], new_caches)``."""
+    import jax
+    import jax.numpy as jnp
+
+    import keras
+
+    FlashMHA = _flash_mha_layer()
+    ctx_new = {}
+    # write cursor as a one-hot over the sequence axis: the cache write
+    # becomes an elementwise select (slot-local under the mesh — a
+    # per-row scatter here would put GSPMD collectives inside the loop)
+    write_mask = (
+        positions[:, None] == jnp.arange(maxlen)[None, :]
+    )[:, :, None, None]
+
+    def handler(op):
+        if isinstance(op, FlashMHA):
+            def attn(x, *_a, **_k):
+                ck, cv = caches[op.name]
+                H, Dh = op.num_heads, op.head_dim
+                qkv = x @ w[op.qkv.kernel.path]  # [B, 3·H·Dh]
+                q, k, v = jnp.split(
+                    qkv.reshape(x.shape[0], 3, H, Dh), 3, axis=1
+                )
+                q, k, v = q[:, 0], k[:, 0], v[:, 0]  # [B, H, Dh]
+                if getattr(op, "rope", False):
+                    cos_np, sin_np = _rope_tables(maxlen, Dh)
+                    cos_t = _rows_at_positions(
+                        jnp.asarray(cos_np), positions
+                    )[:, None, :]
+                    sin_t = _rows_at_positions(
+                        jnp.asarray(sin_np), positions
+                    )[:, None, :]
+                    q = _apply_rope(q, cos_t, sin_t)
+                    k = _apply_rope(k, cos_t, sin_t)
+                ck = jnp.where(write_mask, k[:, None], ck)
+                cv = jnp.where(write_mask, v[:, None], cv)
+                att = jnp.einsum("bhd,bshd->bhs", q, ck) * (Dh**-0.5)
+                visible = (
+                    jnp.arange(maxlen)[None, None, :]
+                    <= positions[:, None, None]
+                )
+                att = jax.nn.softmax(
+                    jnp.where(visible, att, -jnp.inf), axis=-1
+                )
+                o = jnp.einsum("bhs,bshd->bhd", att, cv).reshape(
+                    x.shape[0], H * Dh
+                )
+                ctx_new[op.name] = (ck, cv)
+                return (
+                    o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
+                )
+
+            return attn
+        if isinstance(op, keras.layers.Dropout):
+            return lambda x, *a, **k: x
+        if isinstance(op, keras.Layer) and op.variables:
+            def stateless(*args, _op=op, **kwargs):
+                if kwargs.get("training"):
+                    kwargs["training"] = False
+                args = [
+                    _slice_seq_at_positions(a, positions, maxlen)
+                    for a in args
+                ]
+                tv = [w[v.path] for v in _op.trainable_variables]
+                ntv = [w[v.path] for v in _op.non_trainable_variables]
+                out, _ = _op.stateless_call(tv, ntv, *args, **kwargs)
+                return out
+
+            return stateless
+
+        def weightless(*args, _op=op, **kwargs):
+            args = [
+                _slice_seq_at_positions(a, positions, maxlen) for a in args
+            ]
+            kwargs = {
+                kk: _slice_seq_at_positions(vv, positions, maxlen)
+                for kk, vv in kwargs.items()
+            }
+            return _op(*args, **kwargs)
+
+        return weightless
+
+    logits = model._run_through_graph(tok, operation_fn=handler)
+    return logits, {
+        name: ctx_new.get(name, caches[name]) for name in caches
+    }
+
+
+def prefill_forward(model, w, tokens_rows, caches, admit_mask, maxlen):
+    """Full-sequence forward of a WAVE of (bucket-padded) prompts into
+    their slots: every admitted slot's K/V for positions ``0..S-1``
+    lands in its arena row in ONE pass — one program launch per
+    admission wave per bucket, instead of one per request (prefill
+    launches otherwise rival the decode itself on launch-bound
+    backends).
+
+    ``tokens_rows``: ``[num_slots, S]`` int32, ``S`` the bucket length
+    (compiled once per bucket — the point of bucketing); rows of slots
+    not being admitted carry padding and are masked off the write by
+    ``admit_mask [num_slots]``. Positions past a real prompt hold
+    padding whose K/V is garbage, but decode rewrites each such
+    position before its cursor makes it visible, so no per-row length
+    mask is needed.
+
+    Returns ``(logits [num_slots, S, vocab], new_caches)``."""
+    import jax
+    import jax.numpy as jnp
+
+    import keras
+
+    FlashMHA = _flash_mha_layer()
+    ctx_new = {}
+    S = int(tokens_rows.shape[1])
+
+    def handler(op):
+        if isinstance(op, FlashMHA):
+            def attn(x, *_a, **_k):
+                ck, cv = caches[op.name]
+                H, Dh = op.num_heads, op.head_dim
+                B = x.shape[0]
+                qkv = jnp.reshape(
+                    x @ w[op.qkv.kernel.path], (B, S, 3, H, Dh)
+                )
+                qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3,B,H,S,Dh]
+                q, k, v = qkv[0], qkv[1], qkv[2]
+                if getattr(op, "rope", False):
+                    cos_np, sin_np = _rope_tables(maxlen, Dh)
+                    cos = jnp.asarray(cos_np)[None, None, :S]
+                    sin = jnp.asarray(sin_np)[None, None, :S]
+                    q = _apply_rope(q, cos, sin)
+                    k = _apply_rope(k, cos, sin)
+                att = jnp.einsum("bhid,bhjd->bhij", q, k) * (Dh**-0.5)
+                causal = (
+                    jnp.arange(S)[None, :] <= jnp.arange(S)[:, None]
+                )[None, None]
+                att = jax.nn.softmax(
+                    jnp.where(causal, att, -jnp.inf), axis=-1
+                )
+                o = jnp.einsum("bhij,bhjd->bhid", att, v)
+                o = jnp.reshape(
+                    jnp.transpose(o, (0, 2, 1, 3)), (B, S, H * Dh)
+                )
+                # per-slot row write as a one-hot select (dynamic
+                # scatter on the SHARDED slot axis would make GSPMD
+                # emit collectives — same reasoning as the decode
+                # cursor): [B, S, H, Dh] rows land where admitted
+                k_rows = jnp.transpose(k, (0, 2, 1, 3))  # [B,S,H,Dh]
+                v_rows = jnp.transpose(v, (0, 2, 1, 3))
+                if S < maxlen:
+                    pad = ((0, 0), (0, maxlen - S), (0, 0), (0, 0))
+                    k_rows = jnp.pad(k_rows, pad)
+                    v_rows = jnp.pad(v_rows, pad)
+                sel = (
+                    admit_mask[:, None]
+                    & (jnp.arange(maxlen) < S)[None, :]
+                )[:, :, None, None]
+                ck = jnp.where(sel, k_rows.astype(ck.dtype), ck)
+                cv = jnp.where(sel, v_rows.astype(cv.dtype), cv)
+                ctx_new[op.name] = (ck, cv)
+                return (
+                    o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
+                )
+
+            return attn
+        if isinstance(op, keras.layers.Dropout):
+            return lambda x, *a, **k: x
+        if isinstance(op, keras.Layer) and op.variables:
+            def stateless(*args, _op=op, **kwargs):
+                if kwargs.get("training"):
+                    kwargs["training"] = False
+                args = [_slice_seq_prefix(a, S, maxlen) for a in args]
+                tv = [w[v.path] for v in _op.trainable_variables]
+                ntv = [w[v.path] for v in _op.non_trainable_variables]
+                out, _ = _op.stateless_call(tv, ntv, *args, **kwargs)
+                return out
+
+            return stateless
+
+        def weightless(*args, _op=op, **kwargs):
+            args = [_slice_seq_prefix(a, S, maxlen) for a in args]
+            kwargs = {
+                kk: _slice_seq_prefix(vv, S, maxlen)
+                for kk, vv in kwargs.items()
+            }
+            return _op(*args, **kwargs)
+
+        return weightless
+
+    logits = model._run_through_graph(tokens_rows, operation_fn=handler)
+    return logits, {
+        name: ctx_new.get(name, caches[name]) for name in caches
+    }

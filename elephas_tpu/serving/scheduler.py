@@ -1,0 +1,169 @@
+"""Iteration-level request scheduling (Orca-style continuous batching).
+
+Pure host-side bookkeeping — no jax anywhere: the scheduler decides
+*which* request occupies *which* slot at each engine step, and the
+engine turns those decisions into fixed-shape device programs. Keeping
+this layer free of device state is what makes it trivially SPMD-safe:
+every gang process runs the identical deterministic schedule from the
+identical submission order (the same contract ``generate()`` already
+imposes).
+
+Admission is greedy FIFO into free slots at every step boundary
+(requests submitted mid-flight join the next step's admission wave —
+no generation "epoch" to wait for), and slots reclaim the moment a
+sequence hits EOS or its token budget, so the freed compute is re-used
+by the very next waiting request instead of idling until the batch
+drains.
+
+Prompt lengths are padded up to a fixed **bucket ladder**
+(:func:`default_buckets`: powers of two, capped at the model's
+``maxlen``) so the engine compiles one prefill program per bucket and
+ONE decode program total — a small closed shape set, killing the
+recompile churn the one-shot path's 16-entry jit cache only papers
+over.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+
+
+def default_buckets(max_len: int, floor: int = 16) -> tuple[int, ...]:
+    """Power-of-two prompt buckets ``[floor, 2·floor, ..]`` capped at
+    (and always including) ``max_len``."""
+    if max_len <= 0:
+        raise ValueError(f"max_len must be positive, got {max_len}")
+    buckets = []
+    b = max(1, floor)
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return tuple(buckets)
+
+
+def bucket_for(prompt_len: int, buckets) -> int:
+    """Smallest bucket holding ``prompt_len`` tokens."""
+    for b in buckets:
+        if b >= prompt_len:
+            return int(b)
+    raise ValueError(
+        f"prompt of {prompt_len} tokens exceeds the largest bucket "
+        f"{max(buckets)}"
+    )
+
+
+@dataclass
+class Request:
+    """One in-flight generation request.
+
+    ``tokens`` accumulates the GENERATED continuation only (the prompt
+    is not repeated there); ``emitted`` marks how many of those the
+    caller has already consumed via the streaming iterator."""
+
+    rid: int
+    prompt: tuple
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_id: int | None = None
+    tokens: list = field(default_factory=list)
+    slot: int | None = None
+    done: bool = False
+    emitted: int = 0
+    submit_time: float | None = None
+    finish_time: float | None = None
+
+    @property
+    def full_sequence(self) -> list:
+        return list(self.prompt) + self.tokens
+
+
+class Scheduler:
+    """FIFO queue + slot lease tracking for :class:`InferenceEngine`."""
+
+    def __init__(self, num_slots: int, buckets):
+        self.num_slots = int(num_slots)
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.waiting: deque[Request] = deque()
+        self.active: dict[int, Request] = {}
+        self._free: list[int] = list(range(self.num_slots))
+        self._ids = itertools.count()
+        # occupancy accounting for the serving bench
+        self._steps = 0
+        self._busy_slot_steps = 0
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, request: Request) -> Request:
+        request.rid = next(self._ids) if request.rid is None else request.rid
+        self.waiting.append(request)
+        return request
+
+    def make_request(self, prompt, max_new_tokens, temperature=0.0,
+                     eos_id=None) -> Request:
+        return Request(
+            rid=next(self._ids),
+            prompt=tuple(int(t) for t in prompt),
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature),
+            eos_id=None if eos_id is None else int(eos_id),
+        )
+
+    # -- per-step decisions --------------------------------------------
+
+    def admit(self) -> list[Request]:
+        """Lease free slots to waiting requests (FIFO), lowest slot
+        first — deterministic for the SPMD contract. Returns the newly
+        admitted requests (their ``slot`` set); the engine prefills
+        each."""
+        admitted = []
+        while self.waiting and self._free:
+            req = self.waiting.popleft()
+            req.slot = self._free.pop(0)
+            self.active[req.slot] = req
+            admitted.append(req)
+        return admitted
+
+    def on_token(self, slot: int, token: int) -> bool:
+        """Record one generated token for the slot's occupant; returns
+        True when the request just finished (EOS or budget) — the
+        caller then reclaims the slot."""
+        req = self.active[slot]
+        req.tokens.append(int(token))
+        if (
+            req.eos_id is not None and int(token) == req.eos_id
+        ) or len(req.tokens) >= req.max_new_tokens:
+            req.done = True
+            return True
+        return False
+
+    def reclaim(self, slot: int) -> Request:
+        """Free the slot immediately — the next :meth:`admit` can hand
+        it to a waiting request in the same engine step."""
+        req = self.active.pop(slot)
+        req.slot = None
+        self._free.append(slot)
+        self._free.sort()
+        return req
+
+    def note_step(self) -> None:
+        self._steps += 1
+        self._busy_slot_steps += len(self.active)
+
+    # -- introspection -------------------------------------------------
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.active)
+
+    @property
+    def occupancy(self) -> float:
+        """Mean busy-slot fraction over all decode steps so far."""
+        if self._steps == 0:
+            return 0.0
+        return self._busy_slot_steps / (self._steps * self.num_slots)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        return bucket_for(prompt_len, self.buckets)

@@ -45,10 +45,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elephas_tpu.parallel.mesh import shard_map_compat
+
 
 def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
-    return jax.shard_map(
-        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_rep
+    return shard_map_compat(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check=check_rep
     )
 
 logger = logging.getLogger(__name__)

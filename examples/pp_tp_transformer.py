@@ -37,11 +37,9 @@ def main():
     if len(jax.devices()) < 8:
         # the 2×2×2 mesh needs 8 devices; fall back to a virtual CPU
         # mesh (same mechanism as the driver's multi-chip dry run)
-        from jax.extend.backend import clear_backends
+        from elephas_tpu.utils.backend_guard import force_cpu_devices
 
-        clear_backends()
-        jax.config.update("jax_num_cpu_devices", 8)
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_devices(8)
         print("fewer than 8 accelerators: using an 8-device virtual "
               "CPU mesh")
 

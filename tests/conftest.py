@@ -14,7 +14,18 @@ os.environ.setdefault("KERAS_BACKEND", "jax")
 
 import jax
 
-jax.config.update("jax_num_cpu_devices", 8)
+# version-portable 8-device virtual CPU platform (mirrors
+# elephas_tpu.utils.backend_guard.force_cpu_devices, inlined here so the
+# platform is pinned before ANY library import can touch a backend):
+# newer jax has the jax_num_cpu_devices config, older jaxlibs only honor
+# the XLA_FLAGS host-platform flag (read lazily at CPU client creation)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 jax.config.update("jax_platforms", "cpu")
 from jax.extend.backend import clear_backends
 

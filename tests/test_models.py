@@ -188,7 +188,11 @@ def test_transformer_lm_generate():
                        num_heads=2, num_layers=1, dropout=0.0, lr=1e-2,
                        seed=0)
     sm = SparkModel(m, num_workers=4)
-    history = sm.fit((x, y), epochs=8, batch_size=32)
+    # 16 epochs: under jax 0.4.3x/keras 3.12 the 8-epoch checkpoint of
+    # this fixture lands just short of a clean periodic continuation
+    # (greedy argmax flips one position) — a few more epochs make the
+    # end-task assertion about the MODEL, not optimizer-version noise
+    history = sm.fit((x, y), epochs=16, batch_size=32)
     assert history["loss"][-1] < history["loss"][0]
 
     prompt = np.array([[2, 3, 4, 5], [4, 5, 2, 3]], np.int32)
@@ -583,3 +587,24 @@ def test_generate_kv_cache_rejects_nested_submodel_attention():
                    from_logits=True))
     with pytest.raises(ValueError, match="nested sub-Model"):
         generate(lm, np.array([[1, 2]], np.int32), steps=2, kv_cache=True)
+
+
+def test_decode_jit_cache_lru_refresh():
+    """ADVICE r5: a cache HIT refreshes recency, so a hot decode config
+    survives 16 newer inserts (approximate LRU) instead of being FIFO-
+    evicted and silently recompiled."""
+    from elephas_tpu.models.transformer import _cache_get, _cache_insert
+
+    cache = {}
+    _cache_insert(cache, "hot", "hot-program")
+    for i in range(15):
+        _cache_insert(cache, f"cold{i}", i)
+    assert _cache_get(cache, "hot") == "hot-program"  # refreshes
+    for i in range(15, 30):
+        _cache_insert(cache, f"cold{i}", i)
+    # 15 newer entries arrived since the refresh; the hot entry is
+    # still resident (FIFO would have evicted it at the 17th insert)
+    assert _cache_get(cache, "hot") == "hot-program"
+    assert len(cache) == 16
+    # untouched entries do evict
+    assert _cache_get(cache, "cold0") is None

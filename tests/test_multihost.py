@@ -27,6 +27,11 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Every test here spawns a real multi-process gang (60-150s each; the
+# whole module is far beyond the tier-1 time budget by itself) — run
+# them explicitly or without -m 'not slow'.
+pytestmark = pytest.mark.slow
+
 FIT_SCRIPT = textwrap.dedent(
     """
     import json, hashlib, os, sys
@@ -1117,3 +1122,74 @@ def test_two_process_ring_decode(tmp_path):
     a, b = sorted(results, key=lambda r: r["process"])
     assert a["match"] and b["match"], (a, b)
     assert a["digest"] == b["digest"], (a, b)
+
+
+SERVE_SCRIPT = textwrap.dedent(
+    """
+    import json, hashlib
+    from elephas_tpu.parallel import distributed
+
+    assert distributed.initialize(), "gang init failed"
+    import jax
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    import numpy as np
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate, transformer_lm
+
+    maxlen, vocab, n = 16, 8, 256
+    rng = np.random.default_rng(0)
+    starts = rng.integers(2, 6, size=n)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+
+    m = transformer_lm(vocab_size=vocab, maxlen=maxlen, d_model=32,
+                       num_heads=2, num_layers=1, dropout=0.0, lr=1e-2,
+                       seed=0)
+    # 4x2 ('data','model') mesh SPANNING both processes, like GEN_SCRIPT
+    sm = SparkModel(m, model_parallel=2)
+    sm.fit((x, y), epochs=3, batch_size=32)
+
+    # the serving engine across the gang: both processes drive the
+    # identical submission schedule (SPMD contract); the slot arena is
+    # data-sharded across processes, heads over the model axis
+    engine = sm.serve(num_slots=4)
+    prompts = [[2, 3, 4, 5], [4, 5], [3, 4, 5, 2, 3]]
+    reqs = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    served = engine.run()
+    ok = all(
+        bool((served[r.rid] ==
+              generate(m, np.asarray(p, np.int32)[None], steps=6)[0]
+              ).all())
+        for r, p in zip(reqs, prompts)
+    )
+    print("SERVERESULT " + json.dumps({
+        "process": jax.process_index(),
+        "match": ok,
+        "decode_compiles": engine.compile_stats()["decode_compiles"],
+        "digest": hashlib.sha256(b"".join(
+            np.ascontiguousarray(served[r.rid]).tobytes() for r in reqs
+        )).hexdigest(),
+    }), flush=True)
+    """
+)
+
+
+def test_two_process_serving_engine(tmp_path):
+    """ISSUE 1 (serving tentpole): the continuous-batching engine runs
+    across a 2-process gang on the TP mesh — slot arena data-sharded
+    over processes, weights/heads TP-sharded — with one decode compile
+    and tokens equal to single-device one-shot generate() on both
+    processes."""
+    rc, output = _run_gang(str(tmp_path), SERVE_SCRIPT)
+    assert rc == 0, output[-3000:]
+    results = [
+        json.loads(line.split("SERVERESULT ", 1)[1])
+        for line in output.splitlines()
+        if "SERVERESULT " in line
+    ]
+    assert len(results) == 2, output[-3000:]
+    a, b = sorted(results, key=lambda r: r["process"])
+    assert a["match"] and b["match"], (a, b)
+    assert a["digest"] == b["digest"], (a, b)
+    assert a["decode_compiles"] == 1, a

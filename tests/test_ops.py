@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from elephas_tpu.parallel.mesh import shard_map_compat
+
 from elephas_tpu.ops import flash_attention, ring_attention
 from elephas_tpu.ops.flash_attention import attention_reference
 from elephas_tpu.ops.ring_attention import ring_attention_sharded
@@ -87,8 +89,8 @@ def test_ring_attention_inside_user_shard_map():
         return ring_attention(q, k, v, axis_name="workers", causal=True)
 
     out = jax.jit(
-        jax.shard_map(
-            fn, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False
+        shard_map_compat(
+            fn, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check=False
         )
     )(q, k, v)
     ref = attention_reference(q, k, v, causal=True)
@@ -108,8 +110,8 @@ def test_ring_attention_gradients_match(causal):
         fn = lambda q, k, v: ring_attention(  # noqa: E731
             q, k, v, axis_name="workers", causal=causal
         )
-        out = jax.shard_map(
-            fn, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False
+        out = shard_map_compat(
+            fn, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check=False
         )(q, k, v)
         return jnp.sum(out**2)
 
@@ -236,9 +238,9 @@ def test_ulysses_gradients_match():
         fn = lambda q, k, v: ulysses_attention(  # noqa: E731
             q, k, v, axis_name="seq", causal=True
         )
-        out = jax.shard_map(
+        out = shard_map_compat(
             fn, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
-            check_vma=False,
+            check=False,
         )(q, k, v)
         return jnp.sum(out**2)
 

@@ -1,0 +1,351 @@
+"""Continuous-batching serving engine (ISSUE 1 tentpole).
+
+The acceptance contract: slot-decoded tokens match one-shot
+``generate()`` token-exactly at temperature 0 on mixed-length prompt
+sets; slots reclaim and re-admit mid-flight; the compiled-shape set is
+FIXED — exactly one decode-step compile across a multi-wave workload
+(the compile-count introspection hook); and the engine runs on the DP
+and TP meshes with the arena sharded. Throughput (the >=1.5x claim) is
+owned by ``bench.py --preset serving`` plus the slow-marked test at the
+bottom.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """A small trained LM (periodic sequences, as in
+    test_mesh_generate) — training sharpens the logits so greedy
+    parity across shardings is not a coin flip."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import transformer_lm
+
+    maxlen, vocab, n = 32, 8, 256
+    rng = np.random.default_rng(0)
+    starts = rng.integers(2, 6, size=n)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+    m = transformer_lm(
+        vocab_size=vocab, maxlen=maxlen, d_model=32, num_heads=2,
+        num_layers=2, dropout=0.0, lr=1e-2, seed=0,
+    )
+    SparkModel(m, num_workers=4).fit((x, y), epochs=4, batch_size=32)
+    return m
+
+
+MIXED_PROMPTS = [
+    [2, 3, 4, 5],
+    [4, 5],
+    [3, 4, 5, 2, 3, 4, 5, 2],
+    [5, 2, 3],
+    [2, 3, 4, 5, 2, 3],
+]
+
+
+def _one_shot(lm, prompt, steps, **kw):
+    from elephas_tpu.models import generate
+
+    return generate(
+        lm, np.asarray(prompt, np.int32)[None], steps=steps, **kw
+    )[0]
+
+
+def _check_parity(lm, engine, prompts, steps):
+    reqs = [engine.submit(p, max_new_tokens=steps) for p in prompts]
+    out = engine.run()
+    for req, p in zip(reqs, prompts):
+        ref = _one_shot(lm, p, steps, kv_cache=True)
+        np.testing.assert_array_equal(out[req.rid], ref)
+        # and against the full-recompute path, like the mesh tests
+        ref2 = _one_shot(lm, p, steps)
+        np.testing.assert_array_equal(out[req.rid], ref2)
+    return reqs
+
+
+def test_slot_decode_matches_one_shot_mixed_lengths(lm):
+    """Token-exact greedy parity on a mixed-length prompt set — the
+    slots decode at different cursors inside ONE compiled step, yet
+    every request's tokens equal its own one-shot generate()."""
+    from elephas_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(lm, num_slots=4)
+    _check_parity(lm, engine, MIXED_PROMPTS, steps=8)
+
+
+def test_decode_window_does_not_change_tokens(lm):
+    """steps_per_sync > 1 (multi-step scheduling) trades scheduling
+    granularity for fewer host syncs — never tokens."""
+    from elephas_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(lm, num_slots=4, steps_per_sync=4)
+    _check_parity(lm, engine, MIXED_PROMPTS, steps=7)
+
+
+def test_slot_reclamation_and_midflight_admission(lm):
+    """More requests than slots: finished slots reclaim immediately and
+    waiting requests admit mid-flight; a request submitted WHILE the
+    engine is streaming joins the next wave. All outputs stay
+    token-exact."""
+    from elephas_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(lm, num_slots=2)
+    reqs = [engine.submit(p, max_new_tokens=6) for p in MIXED_PROMPTS]
+    late = None
+    stream = engine.stream()
+    for i, _ in enumerate(stream):
+        if i == 3:  # engine mid-flight: submit one more
+            late = engine.submit([3, 4, 5], max_new_tokens=5)
+    assert late is not None and late.done
+    assert len(engine.finished) == len(MIXED_PROMPTS) + 1
+    # every slot came back
+    assert sorted(engine.scheduler._free) == list(range(engine.num_slots))
+    assert not engine.scheduler.active and not engine.scheduler.waiting
+    for req, p in zip(reqs, MIXED_PROMPTS):
+        np.testing.assert_array_equal(
+            np.asarray(req.full_sequence), _one_shot(lm, p, 6, kv_cache=True)
+        )
+    np.testing.assert_array_equal(
+        np.asarray(late.full_sequence),
+        _one_shot(lm, [3, 4, 5], 5, kv_cache=True),
+    )
+
+
+def test_fixed_compile_count_across_waves(lm):
+    """The compiled-shape contract (the recompile churn the one-shot
+    path's jit cache papers over): across THREE waves of different
+    mixed-length workloads, the decode step compiles exactly once and
+    prefill at most once per bucket."""
+    from elephas_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(lm, num_slots=4)
+    waves = [
+        [([2, 3], 4), ([4, 5, 2, 3, 4], 6)],
+        [([3, 4, 5], 9), ([2, 3, 4, 5, 2, 3, 4], 3), ([5, 5], 5)],
+        [([4, 3, 2], 7)],
+    ]
+    for wave in waves:
+        engine.run(wave)
+    stats = engine.compile_stats()
+    assert stats["decode_compiles"] == 1, stats
+    assert stats["prefill_compiles"] <= len(stats["buckets"]), stats
+
+
+def test_eos_reclaims_early(lm):
+    """A request with an eos_id stops at the first eos token (which is
+    included) and frees its slot for the queue."""
+    from elephas_tpu.serving import InferenceEngine
+
+    ref = _one_shot(lm, [2, 3, 4], 10, kv_cache=True)
+    continuation = ref[3:]
+    eos = int(continuation[4])  # 5th generated token becomes "eos"
+    stop_at = int(np.argmax(continuation == eos)) + 1
+
+    engine = InferenceEngine(lm, num_slots=1)
+    r1 = engine.submit([2, 3, 4], max_new_tokens=10, eos_id=eos)
+    r2 = engine.submit([4, 5], max_new_tokens=4)  # waits for the slot
+    out = engine.run()
+    np.testing.assert_array_equal(
+        out[r1.rid], ref[: 3 + stop_at]
+    )
+    np.testing.assert_array_equal(
+        out[r2.rid], _one_shot(lm, [4, 5], 4, kv_cache=True)
+    )
+
+
+def test_temperature_sampling_is_deterministic_per_config(lm):
+    """temp > 0 requests ride the same engine (per-slot temperature
+    vector); resubmitting the identical workload on a fresh engine with
+    the same seed reproduces the tokens bit-exactly."""
+    from elephas_tpu.serving import InferenceEngine
+
+    def run_once():
+        engine = InferenceEngine(lm, num_slots=2, seed=7)
+        r_greedy = engine.submit([2, 3, 4], 6)
+        r_hot = engine.submit([4, 5], 6, temperature=1.0)
+        out = engine.run()
+        return out[r_greedy.rid], out[r_hot.rid]
+
+    g1, h1 = run_once()
+    g2, h2 = run_once()
+    np.testing.assert_array_equal(g1, g2)
+    np.testing.assert_array_equal(h1, h2)
+    # the greedy request is unaffected by its hot neighbor
+    np.testing.assert_array_equal(
+        g1, _one_shot(lm, [2, 3, 4], 6, kv_cache=True)
+    )
+
+
+def test_stream_done_flag_marks_only_final_token(lm):
+    """The done flag in the stream is per-TOKEN: a consumer stopping a
+    request at its first done=True tuple gets exactly max_new_tokens
+    tokens — even when the whole request completes inside one step's
+    decode window."""
+    from elephas_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(lm, num_slots=2, steps_per_sync=4)
+    r = engine.submit([2, 3, 4], max_new_tokens=3)
+    got = [(tok, done) for rid, tok, done in engine.stream() if rid == r.rid]
+    assert len(got) == 3, got
+    assert [d for _t, d in got] == [False, False, True], got
+    np.testing.assert_array_equal([t for t, _d in got], r.tokens)
+
+
+def test_submit_rejects_prompt_beyond_bucket_ladder(lm):
+    """A custom bucket ladder below maxlen rejects over-long prompts at
+    submit() — not mid-flight with a slot already leased."""
+    from elephas_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(lm, num_slots=2, buckets=(8,))
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        engine.submit(list(range(2, 14)), max_new_tokens=2)
+    assert not engine.scheduler.waiting  # nothing half-queued
+
+
+def test_serve_on_dp_mesh(lm):
+    """SparkModel.serve(): the engine on the plain DP ('workers',)
+    mesh — slots shard over workers, tokens match one-shot."""
+    from elephas_tpu import SparkModel
+
+    engine = SparkModel(lm, num_workers=4).serve(num_slots=4)
+    assert engine.mesh is not None
+    _check_parity(lm, engine, MIXED_PROMPTS[:3], steps=6)
+
+
+def test_serve_on_tp_mesh_keeps_arena_sharded(lm):
+    """model_parallel=2: weights decode TP-sharded and the KV arena
+    shards heads over the model axis (introspected from the live cache
+    buffers), slots over the data axis."""
+    from elephas_tpu import SparkModel
+
+    sm = SparkModel(lm, model_parallel=2)
+    engine = sm.serve(num_slots=4)
+    _check_parity(lm, engine, MIXED_PROMPTS[:3], steps=6)
+    k_buf, _v_buf = next(iter(engine._caches.values()))
+    spec = k_buf.sharding.spec
+    assert spec[0] == ("data",) or spec[0] == "data", spec
+    assert spec[2] == "model", spec  # heads ride the model axis
+
+
+def test_serve_rejects_pipeline_mesh(lm):
+    from elephas_tpu import SparkModel
+
+    sm = SparkModel(lm, pipeline_parallel=2, num_workers=2)
+    with pytest.raises(NotImplementedError, match="ring decode"):
+        sm.serve()
+
+
+def test_engine_rejects_incompatible_models():
+    """The shared validation gate: non-causal attention and
+    sequence-mixing layers are rejected with guidance, not mis-served."""
+    import keras
+
+    from elephas_tpu.models import transformer_classifier
+    from elephas_tpu.serving import InferenceEngine
+
+    clf = transformer_classifier(
+        vocab_size=16, maxlen=8, d_model=16, num_heads=2, num_layers=1
+    )
+    with pytest.raises(ValueError):
+        InferenceEngine(clf)
+
+    mlp = keras.Sequential(
+        [keras.layers.Input((4,)), keras.layers.Dense(2)]
+    )
+    mlp.compile(optimizer="sgd", loss="mse")
+    with pytest.raises(ValueError):
+        InferenceEngine(mlp)
+
+
+def test_submit_validation(lm):
+    from elephas_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(lm, num_slots=2)
+    with pytest.raises(ValueError, match="maxlen"):
+        engine.submit(list(range(2, 30)), max_new_tokens=20)
+    with pytest.raises(ValueError, match="empty"):
+        engine.submit([], max_new_tokens=2)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        engine.submit([2, 3], max_new_tokens=0)
+    with pytest.raises(ValueError, match="num_slots"):
+        InferenceEngine(lm, num_slots=0)
+    with pytest.raises(ValueError, match="overflow the KV arena"):
+        InferenceEngine(lm, buckets=(64,))  # beyond maxlen=32
+
+
+def test_scheduler_bookkeeping():
+    """Pure host-side scheduler semantics: FIFO admission into lowest
+    free slots, immediate reclaim, occupancy accounting."""
+    from elephas_tpu.serving.scheduler import Scheduler, default_buckets
+
+    s = Scheduler(2, default_buckets(64))
+    reqs = [
+        s.submit(s.make_request([1, 2], 3)) for _ in range(3)
+    ]
+    admitted = s.admit()
+    assert [r.slot for r in admitted] == [0, 1]
+    assert s.admit() == []  # full
+    assert not s.on_token(0, 9)  # 1/3 tokens
+    assert not s.on_token(0, 9)
+    assert s.on_token(0, 9)  # budget reached
+    s.reclaim(0)
+    assert s.admit()[0] is reqs[2] and reqs[2].slot == 0
+    s.note_step()
+    assert s.occupancy == 1.0  # both slots busy on the counted step
+
+
+def test_bucket_ladder():
+    from elephas_tpu.serving.scheduler import bucket_for, default_buckets
+
+    assert default_buckets(128) == (16, 32, 64, 128)
+    assert default_buckets(100) == (16, 32, 64, 100)
+    assert bucket_for(3, (16, 32)) == 16
+    assert bucket_for(17, (16, 32)) == 32
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_for(33, (16, 32))
+
+
+@pytest.mark.slow
+def test_continuous_batching_beats_sequential_on_mesh(lm):
+    """The headline perf claim (acceptance: >=1.5x on the 8-device CPU
+    mesh), asserted at a noise-robust threshold over the median of 3
+    alternating rounds — bench.py --preset serving owns the full
+    artifact."""
+    import time
+
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate
+    from elephas_tpu.serving import InferenceEngine
+    from elephas_tpu.parallel.mesh import worker_mesh
+
+    mesh = worker_mesh(None)
+    rng = np.random.default_rng(0)
+    plens = (4, 6, 8, 12)
+    workload = [
+        (rng.integers(2, 6, size=int(plens[i % 4])).astype(np.int32), 12)
+        for i in range(32)
+    ]
+    engine = InferenceEngine(
+        lm, num_slots=16, mesh=mesh, batch_axes=("workers",),
+        steps_per_sync=8,
+    )
+    # warmup both paths
+    for p, mn in workload[:4]:
+        generate(lm, p[None], steps=mn, kv_cache=True, mesh=mesh,
+                 batch_axes=("workers",))
+    engine.run(workload[:16])
+    ratios = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for p, mn in workload:
+            generate(lm, p[None], steps=mn, kv_cache=True, mesh=mesh,
+                     batch_axes=("workers",))
+        seq_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        engine.run(workload)
+        srv_dt = time.perf_counter() - t0
+        ratios.append(seq_dt / srv_dt)
+    ratios.sort()
+    assert ratios[1] >= 1.5, ratios
+    assert engine.compile_stats()["decode_compiles"] == 1

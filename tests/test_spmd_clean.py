@@ -27,8 +27,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SCRIPT = textwrap.dedent(
     """
+    from elephas_tpu.utils.backend_guard import force_cpu_devices
+    force_cpu_devices(8)
     import jax
-    jax.config.update('jax_num_cpu_devices', 8)
     import numpy as np
     from elephas_tpu import SparkModel
     from elephas_tpu.models import transformer_classifier
