@@ -552,6 +552,250 @@ def measure_serving(n_requests: int, num_slots: int, backend: str,
     }
 
 
+def _ps_weights(seed=0):
+    """~2 MB mixed-shape float32 weight list — MLP-shaped, big enough
+    that sync bytes dominate pickle overhead, small enough for CI."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    shapes = [(256, 512), (512,), (512, 512), (512,), (512, 128), (128,)]
+    return [rng.normal(size=s).astype(np.float32) for s in shapes]
+
+
+def _ps_client(transport, port, compression, topk, force_pickle):
+    from elephas_tpu.parameter.client import HttpClient, SocketClient
+
+    cls = {"socket": SocketClient, "http": HttpClient}[transport]
+    client = cls(
+        master=f"127.0.0.1:{port}", compression=compression, topk=topk
+    )
+    if force_pickle:
+        # measure the legacy wire exactly as an old client would speak it
+        client._binary = False
+    return client
+
+
+def measure_ps_wire(transport: str, rounds: int):
+    """Bytes-per-sync and round-trip latency of one get+update cycle,
+    per wire config, against one live server on loopback.
+
+    Configs: the legacy pickle protocol (baseline), dense binary codec
+    (dtype-preserving, no loss), int8 (quantized pull AND push, with
+    error feedback on pushes), int8+topk (plus top-1% delta
+    sparsification). Every config performs REAL protocol round-trips —
+    bytes come from the clients' wire counters, not arithmetic.
+    """
+    import numpy as np
+
+    from elephas_tpu.parameter.server import HttpServer, SocketServer
+
+    weights = _ps_weights()
+    rng = np.random.default_rng(1)
+    deltas = [
+        [np.asarray(rng.normal(size=w.shape) * 1e-3, w.dtype) for w in weights]
+        for _ in range(4)
+    ]
+    server_cls = {"socket": SocketServer, "http": HttpServer}[transport]
+    server = server_cls(weights, mode="asynchronous", port=0)
+    server.start()
+    configs = [
+        ("pickle", "none", None, True),
+        ("binary", "none", None, False),
+        ("int8", "int8", None, False),
+        ("int8_topk", "int8", 0.01, False),
+    ]
+    out = {}
+    try:
+        for name, compression, topk, force_pickle in configs:
+            client = _ps_client(
+                transport, server.port, compression, topk, force_pickle
+            )
+            # warmup: negotiation + one full cycle outside the window
+            client.update_parameters(deltas[0])
+            client.get_parameters()
+            n = rounds
+            for _attempt in range(MEASURE_RETRIES):
+                client.reset_counters()
+                lat = []
+                t_all = time.perf_counter()
+                for i in range(n):
+                    t0 = time.perf_counter()
+                    client.update_parameters(deltas[i % len(deltas)])
+                    client.get_parameters()
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                dt = time.perf_counter() - t_all
+                if dt > MIN_CREDIBLE_DT:
+                    break
+                # real round-trips scale linearly with the round count;
+                # a lying clock stays ~0 no matter how many are queued
+                n *= 8
+                log.info(
+                    "ps wire window %.4fs under the floor; scaling to "
+                    "%d rounds", dt, n,
+                )
+            else:
+                raise ImplausibleTiming(
+                    f"ps wire window {dt:.4f}s below the "
+                    f"{MIN_CREDIBLE_DT}s credibility floor"
+                )
+            bytes_per_sync = (client.bytes_sent + client.bytes_received) / n
+            out[name] = {
+                "bytes_per_sync": round(bytes_per_sync, 1),
+                "p50_ms": round(float(np.percentile(lat, 50)), 3),
+                "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            }
+            if hasattr(client, "close"):
+                client.close()
+            log.info(
+                "ps wire [%s/%s]: %.0f bytes/sync, p50 %.2fms p99 %.2fms",
+                transport, name, bytes_per_sync,
+                out[name]["p50_ms"], out[name]["p99_ms"],
+            )
+    finally:
+        server.stop()
+    dense = sum(w.nbytes for w in weights)
+    for cfg in out.values():
+        cfg["vs_dense_weights"] = round(
+            cfg["bytes_per_sync"] / (2 * dense), 3
+        )
+    return out
+
+
+def measure_ps_training(transport: str, rows: int, epochs: int):
+    """Async-mode epoch throughput of a real ``AsynchronousSparkWorker``
+    against a live server, per-batch sync: legacy pickle + blocking sync
+    (the reference's wire) vs the ISSUE 2 fast path — int8+top-1% delta
+    pushes with error feedback (DGC-style: compress the gradients, pull
+    dense weights) overlapped under the next batch's compute. Both run
+    the same model/data/epochs; samples/sec is end-to-end wall clock
+    including every sync. The model is sized so each sync moves ~4 MB —
+    a wire share the reference actually suffers at scale.
+    """
+    import numpy as np
+
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    import keras
+
+    from elephas_tpu.parameter.server import HttpServer, SocketServer
+    from elephas_tpu.worker import AsynchronousSparkWorker
+
+    rng = np.random.default_rng(7)
+    d, k = 32, 3
+    x = rng.normal(size=(rows, d)).astype(np.float32)
+    y = rng.integers(0, k, size=rows).astype(np.int32)
+
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([
+        keras.layers.Input((d,)),
+        keras.layers.Dense(1024, activation="relu"),
+        keras.layers.Dense(1024, activation="relu"),
+        keras.layers.Dense(k, activation="softmax"),
+    ])
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    json_model = model.to_json()
+    server_cls = {"socket": SocketServer, "http": HttpServer}[transport]
+
+    def run(mode, fast: bool) -> float:
+        server = server_cls(model.get_weights(), mode=mode, port=0)
+        server.start()
+        try:
+            worker = AsynchronousSparkWorker(
+                json_model,
+                train_config={"epochs": epochs, "batch_size": 64},
+                frequency="batch",
+                parameter_server_mode=transport,
+                master=f"127.0.0.1:{server.port}",
+                master_optimizer="adam",
+                master_loss="sparse_categorical_crossentropy",
+                compression="int8" if fast else "none",
+                topk=0.01 if fast else None,
+                pull_compression="none",
+                overlap=fast,
+            )
+            if not fast:
+                # pin the baseline to the legacy pickle wire
+                real = worker._client
+
+                def legacy_client(model=None):
+                    c = real(model)
+                    c._binary = False
+                    return c
+
+                worker._client = legacy_client
+            # warmup epoch (keras compile) outside the timed window
+            list(worker.train(iter(zip(x[:64], y[:64]))))
+            t0 = time.perf_counter()
+            list(worker.train(iter(zip(x, y))))
+            dt = time.perf_counter() - t0
+            if not (dt > MIN_CREDIBLE_DT):
+                raise ImplausibleTiming(
+                    f"ps training window {dt:.4f}s below the "
+                    f"{MIN_CREDIBLE_DT}s credibility floor"
+                )
+            return rows * epochs / dt
+        finally:
+            server.stop()
+
+    out = {}
+    for mode in ("asynchronous", "hogwild"):
+        # ALTERNATE baseline and fast path inside each round so an
+        # ambient machine-regime shift hits both; the median round is
+        # the headline (same honesty contract as the serving bench)
+        rounds = []
+        for _ in range(3):
+            base = run(mode, fast=False)
+            fast = run(mode, fast=True)
+            rounds.append((fast / base, base, fast))
+        rounds.sort(key=lambda r: r[0])
+        speedup, base, fast = rounds[(len(rounds) - 1) // 2]
+        out[mode] = {
+            "pickle_sps": round(base, 1),
+            "fast_sps": round(fast, 1),
+            "speedup": round(speedup, 3),
+            "speedup_rounds": [round(r[0], 3) for r in rounds],
+        }
+        log.info(
+            "ps training [%s/%s]: pickle %.0f samples/s, "
+            "int8+topk+overlap %.0f samples/s (median %.2fx; "
+            "per-round %s)",
+            transport, mode, base, fast, speedup,
+            [round(r[0], 2) for r in rounds],
+        )
+    return out
+
+
+def measure_ps(transport: str, rounds: int, rows: int, epochs: int):
+    """``--preset ps`` (ISSUE 2): the parameter-sync fast path vs the
+    pickle wire — bytes-per-sync + latency microbench and end-to-end
+    async worker throughput. One JSON record, same honesty contract as
+    the training bench."""
+    wire_stats = measure_ps_wire(transport, rounds)
+    training = measure_ps_training(transport, rows, epochs)
+    reduction = (
+        wire_stats["pickle"]["bytes_per_sync"]
+        / wire_stats["int8_topk"]["bytes_per_sync"]
+    )
+    return {
+        "metric": f"parameter-sync bytes per get+update round ({transport})",
+        "value": wire_stats["int8_topk"]["bytes_per_sync"],
+        "unit": "bytes/sync",
+        "vs_baseline": round(
+            wire_stats["int8_topk"]["bytes_per_sync"]
+            / wire_stats["pickle"]["bytes_per_sync"],
+            4,
+        ),
+        "bytes_reduction_int8_topk": round(reduction, 2),
+        "bytes_reduction_int8": round(
+            wire_stats["pickle"]["bytes_per_sync"]
+            / wire_stats["int8"]["bytes_per_sync"],
+            2,
+        ),
+        "wire": wire_stats,
+        "epoch_throughput": training,
+        "rounds": rounds,
+    }
+
+
 def measure_keras_fit(model, x, y, batch_size, epochs):
     """Stock keras ``model.fit`` images/sec (the glue-path floor only —
     numpy fed per batch; NOT the honest baseline)."""
@@ -564,11 +808,26 @@ def measure_keras_fit(model, x, y, batch_size, epochs):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--preset", choices=["auto", "full", "tiny", "serving"],
+    p.add_argument("--preset",
+                   choices=["auto", "full", "tiny", "serving", "ps"],
                    default="auto",
                    help="serving = the continuous-batching engine bench "
                         "(aggregate tok/s, per-request p50/p99 latency, "
-                        "slot occupancy) instead of the training bench")
+                        "slot occupancy); ps = the parameter-sync wire "
+                        "bench (bytes-per-sync, sync latency, async "
+                        "worker throughput vs the pickle baseline)")
+    p.add_argument("--ps-transport", choices=["socket", "http"],
+                   default="socket",
+                   help="ps preset: which server/client pair to measure")
+    p.add_argument("--ps-rounds", type=int, default=30,
+                   help="ps preset: timed get+update round-trips per "
+                        "wire config")
+    p.add_argument("--ps-rows", type=int, default=512,
+                   help="ps preset: training rows for the async worker "
+                        "throughput comparison")
+    p.add_argument("--ps-epochs", type=int, default=2,
+                   help="ps preset: epochs for the async worker "
+                        "throughput comparison")
     p.add_argument("--serving-requests", type=int, default=48,
                    help="serving preset: requests in the workload")
     p.add_argument("--serving-slots", type=int, default=16,
@@ -621,6 +880,22 @@ def main():
         log.info(
             "flash blocks: q=%d k=%d", fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K
         )
+
+    if args.preset == "ps":
+        # loopback sockets + a tiny keras model — no mesh needed, and no
+        # TPU probe either (keep the artifact safe from a dead tunnel)
+        try:
+            out = measure_ps(
+                args.ps_transport,
+                max(1, args.ps_rounds),
+                max(64, args.ps_rows),
+                max(1, args.ps_epochs),
+            )
+        except ImplausibleTiming as e:
+            log.error("ps bench implausible: %s — no JSON", e)
+            sys.exit(1)
+        print(json.dumps(out))
+        return
 
     if args.preset == "serving":
         # the serving comparison runs over the 8-device worker mesh; on

@@ -9,6 +9,12 @@ In the TPU rebuild the hot path is in-XLA collectives; these classes
 remain for (a) API parity, (b) a coordinator-hosted weight store over DCN
 for external pollers / cross-job consumers, and (c) faithful unit-testable
 semantics of the async/hogwild locking difference.
+
+ISSUE 2 replaced the pickled wire format with a binary codec
+(:mod:`elephas_tpu.parameter.codec` — dtype-preserving frames, optional
+int8 quantization with error-feedback residuals, optional top-k delta
+sparsification) negotiated per connection, with pickle kept as the
+legacy fallback.
 """
 
 from elephas_tpu.parameter.server import (  # noqa: F401
@@ -20,4 +26,8 @@ from elephas_tpu.parameter.client import (  # noqa: F401
     BaseParameterClient,
     HttpClient,
     SocketClient,
+)
+from elephas_tpu.parameter.codec import (  # noqa: F401
+    ErrorFeedback,
+    WireCodec,
 )

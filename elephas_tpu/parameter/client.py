@@ -3,18 +3,87 @@
 Reference surface: ``[U] elephas/parameter/client.py`` —
 ``BaseParameterClient`` with ``get_parameters()`` / ``update_parameters``;
 ``HttpClient`` over urllib, ``SocketClient`` over raw TCP.
+
+ISSUE 2: both clients speak the binary codec
+(:mod:`elephas_tpu.parameter.codec`) on the hot path — dtype-preserving
+frames, optional int8 pulls, optional int8/top-k delta pushes with
+error-feedback residuals — over ONE reused connection with connect/read
+timeouts and capped-exponential-backoff retries. Pickle survives only as
+the negotiated fallback against legacy servers (detected per client on
+first use: a 404 on ``/parameters.bin``, or a closed socket after the
+``b'?'`` capability probe).
+
+``bytes_sent`` / ``bytes_received`` count payload bytes on the wire so
+callers (``bench.py --preset ps``) can report bytes-per-sync honestly.
 """
 
 from __future__ import annotations
 
+import http.client
+import logging
 import pickle
 import socket
-import urllib.request
 
+from elephas_tpu.parameter import codec as wire
 from elephas_tpu.utils import sockets
+
+logger = logging.getLogger(__name__)
+
+
+def _split_master(master: str | None, port: int) -> tuple[str, int]:
+    master = master or sockets.determine_master(port)
+    if "//" in master:
+        master = master.split("//", 1)[1]
+    host, _, p = master.partition(":")
+    return host or "127.0.0.1", int(p or port)
 
 
 class BaseParameterClient:
+    """Shared wire-codec state: compression knobs, error feedback,
+    byte counters, and the legacy-fallback flag."""
+
+    def __init__(
+        self,
+        compression: str = "none",
+        topk: float | None = None,
+        pull_compression: str | None = None,
+    ):
+        for c in (compression, pull_compression):
+            if c is not None and c not in wire.COMPRESSIONS:
+                raise ValueError(
+                    f"compression must be one of {wire.COMPRESSIONS}, "
+                    f"got {c!r}"
+                )
+        self.compression = compression
+        self.topk = topk
+        # pushes and pulls compress independently: DGC-style setups
+        # quantize/sparsify the pushed deltas (error feedback keeps them
+        # honest) while pulling dense weights — pull quantization has no
+        # feedback loop, so it defaults to following `compression` only
+        # when explicitly unset
+        self.pull_compression = (
+            compression if pull_compression is None else pull_compression
+        )
+        self._update_codec = wire.WireCodec(compression=compression, topk=topk)
+        self._feedback = (
+            wire.ErrorFeedback()
+            if (compression != "none" or topk is not None)
+            else None
+        )
+        self._binary: bool | None = None  # None until negotiated
+        self.bytes_sent = 0
+        self.bytes_received = 0
+
+    def reset_counters(self) -> None:
+        self.bytes_sent = 0
+        self.bytes_received = 0
+
+    def _encode_update(self, delta) -> bytes:
+        """Encode ONCE per update — the error-feedback residual mutates
+        at encode time, so retries must resend these bytes, never
+        re-encode."""
+        return self._update_codec.encode(delta, self._feedback)
+
     def get_parameters(self):
         raise NotImplementedError
 
@@ -23,46 +92,304 @@ class BaseParameterClient:
 
 
 class HttpClient(BaseParameterClient):
-    def __init__(self, master: str | None = None, port: int = 4000):
-        master = master or sockets.determine_master(port)
-        if not master.startswith("http"):
-            master = "http://" + master
-        self.master_url = master
+    def __init__(
+        self,
+        master: str | None = None,
+        port: int = 4000,
+        compression: str = "none",
+        topk: float | None = None,
+        pull_compression: str | None = None,
+        timeout: float = sockets.IO_TIMEOUT,
+        retries: int = 3,
+    ):
+        super().__init__(compression, topk, pull_compression)
+        self.host, self.port = _split_master(master, port)
+        self.master_url = f"http://{self.host}:{self.port}"
+        self.timeout = timeout
+        self.retries = retries
+        self._conn: http.client.HTTPConnection | None = None
+
+    # -- connection management ----------------------------------------
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
+            )
+            self._conn.connect()
+            # headers and body go out as separate writes; without
+            # NODELAY each POST eats a Nagle/delayed-ACK stall
+            self._conn.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+        return self._conn
+
+    def _reset(self, *_args) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def close(self) -> None:
+        self._reset()
+
+    def _retry(self, fn):
+        return sockets.retry_call(
+            fn, retries=self.retries, on_retry=self._reset
+        )
+
+    def _resp_reader(self, resp):
+        def read_exact(n: int) -> bytes:
+            chunks, got = [], 0
+            while got < n:
+                chunk = resp.read(n - got)
+                if not chunk:
+                    raise ConnectionError("server closed mid-frame")
+                chunks.append(chunk)
+                got += len(chunk)
+            self.bytes_received += n
+            return b"".join(chunks)
+
+        def readinto(mv: memoryview) -> int:
+            got = resp.readinto(mv)
+            self.bytes_received += got or 0
+            return got
+
+        return read_exact, readinto
+
+    # -- protocol ------------------------------------------------------
 
     def get_parameters(self):
-        with urllib.request.urlopen(self.master_url + "/parameters") as r:
-            return pickle.loads(r.read())
+        return self._retry(self._get_once)
+
+    def _get_once(self):
+        if self._binary is not False:
+            conn = self._connection()
+            path = "/parameters.bin" + (
+                "?comp=int8" if self.pull_compression == "int8" else ""
+            )
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status == 200:
+                self._binary = True
+                out = wire.decode_stream(*self._resp_reader(resp))
+                resp.read()  # drain to keep the connection reusable
+                return out
+            resp.read()
+            if resp.status != 404:
+                raise ConnectionError(f"GET {path} -> {resp.status}")
+            self._binary = False  # legacy server: pickle from here on
+        conn = self._connection()
+        conn.request("GET", "/parameters")
+        resp = conn.getresponse()
+        if resp.status != 200:
+            resp.read()
+            raise ConnectionError(f"GET /parameters -> {resp.status}")
+        payload = resp.read()
+        self.bytes_received += len(payload)
+        return pickle.loads(payload)  # legacy-pickle fallback path
 
     def update_parameters(self, delta) -> None:
-        payload = pickle.dumps(delta)
-        req = urllib.request.Request(
-            self.master_url + "/update",
-            data=payload,
+        """Push one delta. Retries make this at-least-once: if the
+        server applied the POST but the response was lost, the resend
+        applies it twice (a doubled additive step) — the async/hogwild
+        trade, chosen over the legacy wire's silent at-most-once."""
+        if self._binary is False and self._feedback is None:
+            # known-legacy server + lossless push: pickle the delta
+            # directly, skipping a pointless codec encode+decode pass
+            self._retry(lambda: self._legacy_update(pickle.dumps(delta)))
+            return
+        body = self._encode_update(delta)
+        self._retry(lambda: self._update_once(body))
+
+    def _update_once(self, body: bytes) -> None:
+        if self._binary is not False:
+            conn = self._connection()
+            conn.request(
+                "POST",
+                "/update.bin",
+                body=body,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status == 200:
+                self._binary = True
+                self.bytes_sent += len(body)
+                return
+            if resp.status != 404:
+                raise ConnectionError(f"POST /update.bin -> {resp.status}")
+            self._binary = False
+        # Legacy server: ship the delta AS THE SERVER WILL SEE IT — the
+        # locally-decoded frames — so the error-feedback residual
+        # (absorbed at encode time) stays exact.
+        self._legacy_update(pickle.dumps(wire.decode(body)))
+
+    def _legacy_update(self, payload: bytes) -> None:
+        conn = self._connection()
+        conn.request(
+            "POST",
+            "/update",
+            body=payload,
             headers={"Content-Type": "application/octet-stream"},
-            method="POST",
         )
-        urllib.request.urlopen(req).read()
+        resp = conn.getresponse()
+        resp.read()
+        if resp.status != 200:
+            raise ConnectionError(f"POST /update -> {resp.status}")
+        self.bytes_sent += len(payload)
 
 
 class SocketClient(BaseParameterClient):
-    def __init__(self, master: str | None = None, port: int = 4000):
-        master = master or sockets.determine_master(port)
-        host, _, p = master.partition(":")
-        self.host = host
-        self.port = int(p or port)
-        self._sock = socket.create_connection((self.host, self.port))
+    def __init__(
+        self,
+        master: str | None = None,
+        port: int = 4000,
+        compression: str = "none",
+        topk: float | None = None,
+        pull_compression: str | None = None,
+        connect_timeout: float = sockets.CONNECT_TIMEOUT,
+        io_timeout: float = sockets.IO_TIMEOUT,
+        retries: int = 3,
+    ):
+        super().__init__(compression, topk, pull_compression)
+        self.host, self.port = _split_master(master, port)
+        self.connect_timeout = connect_timeout
+        self.io_timeout = io_timeout
+        self.retries = retries
+        self._sock = None
+        self._pending_acks = 0
+        self.updates_lost = 0  # unacked pushes dropped with a dead conn
+        self._connect()
+
+    # -- connection management ----------------------------------------
+
+    def _connect(self) -> None:
+        self._sock = sockets.connect(
+            self.host, self.port, self.connect_timeout, self.io_timeout
+        )
+        if self._binary is None:
+            # capability probe: a binary server answers with its protocol
+            # version; a legacy server closes the connection on the
+            # unknown op (we reconnect and stay on pickle)
+            try:
+                self._sock.sendall(b"?")
+                ver = sockets.read_exact(self._sock, 1)
+                self._binary = ver[0] >= 1
+            except (ConnectionError, OSError):
+                self._binary = False
+                self._sock = sockets.connect(
+                    self.host, self.port, self.connect_timeout,
+                    self.io_timeout,
+                )
+
+    def _reconnect(self, *_args) -> None:
+        self._close_sock()
+        if self._pending_acks:
+            # a pipelined update died on the wire before its ack: the
+            # server may never have applied it (and the error-feedback
+            # residual was already absorbed at encode time). Async/
+            # hogwild training tolerates a lost delta statistically, so
+            # this is surfaced loudly rather than fatally.
+            self.updates_lost += self._pending_acks
+            logger.warning(
+                "connection lost with %d unacked update(s) — the "
+                "delta(s) may not have been applied (updates_lost=%d)",
+                self._pending_acks, self.updates_lost,
+            )
+        self._pending_acks = 0
+        self._connect()
+
+    def _drain_acks(self) -> None:
+        """Collect outstanding update acks. Pushes are PIPELINED — the
+        legacy pickle update is fire-and-forget, so blocking a full
+        round-trip per binary push would regress it; instead the ack is
+        read before the next op on this connection (the server answers
+        ops in order), keeping error detection without the stall."""
+        while self._pending_acks:
+            ack = sockets.read_exact(self._sock, 1)
+            self._pending_acks -= 1
+            if ack != b"k":
+                raise ConnectionError(f"bad update ack {ack!r}")
+
+    def _close_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _retry(self, fn):
+        return sockets.retry_call(
+            fn, retries=self.retries, on_retry=self._reconnect
+        )
+
+    def _counting_reader(self):
+        read = sockets.reader(self._sock)
+        recv_into = sockets.reader_into(self._sock)
+
+        def read_exact(n: int) -> bytes:
+            buf = read(n)
+            self.bytes_received += n
+            return buf
+
+        def readinto(mv: memoryview) -> int:
+            got = recv_into(mv)
+            self.bytes_received += got or 0
+            return got
+
+        return read_exact, readinto
+
+    # -- protocol ------------------------------------------------------
 
     def get_parameters(self):
+        return self._retry(self._get_once)
+
+    def _get_once(self):
+        if self._binary:
+            self._drain_acks()
+            comp = b"\x01" if self.pull_compression == "int8" else b"\x00"
+            self._sock.sendall(b"G" + comp)
+            return wire.decode_stream(*self._counting_reader())
         self._sock.sendall(b"g")
-        return sockets.receive(self._sock)
+        # legacy-pickle fallback path
+        out, nbytes = sockets.receive_with_size(self._sock)
+        if out is None:
+            raise ConnectionError("server closed during get")
+        self.bytes_received += nbytes
+        return out
 
     def update_parameters(self, delta) -> None:
+        """Push one delta. Retries after a reconnect make this
+        at-least-once (a resend can double-apply if the server took the
+        first copy before the drop); a push whose connection dies
+        before its pipelined ack is counted in ``updates_lost``."""
+        if self._binary:
+            body = self._encode_update(delta)  # once: feedback mutates
+            self._retry(lambda: self._push_once(body))
+        else:
+            self._retry(lambda: self._push_pickle(delta))
+
+    def _push_once(self, body: bytes) -> None:
+        self._drain_acks()
+        self._sock.sendall(b"U" + body)
+        self._pending_acks += 1
+        self.bytes_sent += len(body)
+
+    def _push_pickle(self, delta) -> None:
         self._sock.sendall(b"u")
-        sockets.send(self._sock, delta)
+        # legacy-pickle fallback path
+        self.bytes_sent += sockets.send(self._sock, delta)
 
     def close(self) -> None:
+        if self._sock is None:
+            return
         try:
+            self._drain_acks()  # surface in-flight update failures
             self._sock.sendall(b"q")
         except OSError:
             pass
-        self._sock.close()
+        self._close_sock()

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import socket
 import struct
 import subprocess
 import threading
@@ -98,16 +97,22 @@ class _Flattener:
 
     The wire/store format is float32 only; anything float32 can't carry
     exactly (float64, int tensors) is rejected loudly rather than
-    silently rounded — the pickle servers preserve those dtypes.
+    silently rounded — the binary-codec Python servers
+    (:mod:`elephas_tpu.parameter.codec`) preserve those dtypes.
+    ``float16``/``bfloat16`` embed exactly in float32, so they pass
+    (the codec's float-likeness test covers bf16, which numpy's
+    ``issubdtype`` does not recognize as floating).
     """
 
     def __init__(self, weights):
+        from elephas_tpu.parameter.codec import _is_floatlike
+
         self.shapes = [np.asarray(w).shape for w in weights]
         self.dtypes = [np.asarray(w).dtype for w in weights]
         bad = [
             str(d)
             for d in self.dtypes
-            if not (np.issubdtype(d, np.floating) and d.itemsize <= 4)
+            if not (_is_floatlike(d) and d.itemsize <= 4)
         ]
         if bad:
             raise ValueError(
@@ -200,9 +205,11 @@ class NativeClient:
     host; carries a ``_Flattener`` built from the model's weight spec)."""
 
     def __init__(self, host: str, port: int, flattener: _Flattener):
+        from elephas_tpu.utils import sockets
+
         self._flat = flattener
-        self._sock = socket.create_connection((host, port))
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # hardened connect: deadline + NODELAY (utils.sockets)
+        self._sock = sockets.connect(host, port)
 
     def _recv_exact(self, n: int) -> bytes:
         buf = bytearray()

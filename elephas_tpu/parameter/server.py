@@ -7,21 +7,39 @@ mode='asynchronous' and lock-free for 'hogwild' — that lock is the entire
 difference between the modes) and ``SocketServer`` (TCP op-code protocol).
 
 Rebuilt on the stdlib (`http.server`, `socketserver`) — Flask is not a
-dependency. Payloads are pickled numpy weight lists, same wire idea as the
-reference; do not expose these ports to untrusted networks (pickle).
+dependency. ISSUE 2: the hot path is the **binary codec**
+(:mod:`elephas_tpu.parameter.codec` — versioned frames, dtype-preserving,
+optional int8 get) streamed chunk-by-chunk, so peak transient memory
+stays bounded at one chunk. The pickled endpoints/op-codes remain as the
+negotiated legacy fallback; do not expose these ports to untrusted
+networks.
+
+Socket op-codes: ``b'?'`` capability probe (reply: protocol version
+byte), ``b'G'`` binary get (+1 request byte: 0 dense / 1 int8),
+``b'U'`` binary update (frames in, ``b'k'`` ack out), and the legacy
+``b'g'`` / ``b'u'`` / ``b'q'`` pickle trio.
+
+HTTP: ``GET /parameters.bin[?comp=int8]`` streams codec frames with
+chunked transfer-encoding; ``POST /update.bin`` carries codec frames in
+the body; legacy ``/parameters`` / ``/update`` stay pickled. Responses
+are HTTP/1.1 so clients reuse one connection across sync rounds.
 """
 
 from __future__ import annotations
 
 import pickle
+import socket
 import socketserver
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from elephas_tpu.parameter import codec as wire
 from elephas_tpu.utils import sockets
 from elephas_tpu.utils.functional_utils import add_params
+
+PROTOCOL_VERSION = 1
 
 
 class BaseParameterServer:
@@ -38,6 +56,8 @@ class BaseParameterServer:
         self.lock = threading.Lock()
         self.weights = [np.asarray(w) for w in weights]
         self._started = False
+        self._dense_codec = wire.WireCodec()
+        self._int8_codec = wire.WireCodec(compression="int8")
 
     # -- weight store --------------------------------------------------
 
@@ -58,6 +78,11 @@ class BaseParameterServer:
         with self.lock:
             self.weights = [np.asarray(w) for w in weights]
 
+    def encode_parameters(self, compression: str = "none"):
+        """Current weights as codec frames (the binary get path)."""
+        enc = self._int8_codec if compression == "int8" else self._dense_codec
+        return enc.encode_frames(self.get_parameters())
+
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
@@ -68,7 +93,7 @@ class BaseParameterServer:
 
 
 class HttpServer(BaseParameterServer):
-    """``GET /parameters`` / ``POST /update`` over stdlib HTTP."""
+    """``GET /parameters[.bin]`` / ``POST /update[.bin]`` over stdlib HTTP."""
 
     def __init__(self, weights, mode: str = "asynchronous", port: int = 4000):
         super().__init__(weights, mode, port)
@@ -79,11 +104,38 @@ class HttpServer(BaseParameterServer):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # connection reuse across syncs
+            disable_nagle_algorithm = True
+
             def log_message(self, *args):  # silence request logging
                 pass
 
             def do_GET(self):
-                if self.path != "/parameters":
+                path, _, query = self.path.partition("?")
+                if path == "/parameters.bin":
+                    comp = "int8" if "comp=int8" in query else "none"
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/octet-stream"
+                    )
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    self.wfile.flush()
+
+                    # one TE chunk per codec piece, written through the
+                    # coalescing sender: size lines and small frames
+                    # batch up, large payload memoryviews pass through
+                    # zero-copy (wfile would concat-copy them)
+                    def te_pieces():
+                        for piece in server.encode_parameters(comp):
+                            yield f"{len(piece):x}\r\n".encode()
+                            yield piece
+                            yield b"\r\n"
+                        yield b"0\r\n\r\n"
+
+                    sockets.send_frames(self.connection, te_pieces())
+                    return
+                if path != "/parameters":
                     self.send_error(404)
                     return
                 payload = pickle.dumps(server.get_parameters())
@@ -93,12 +145,34 @@ class HttpServer(BaseParameterServer):
                 self.end_headers()
                 self.wfile.write(payload)
 
+            def _read_exact(self, n: int) -> bytes:
+                chunks, got = [], 0
+                while got < n:
+                    chunk = self.rfile.read(min(n - got, 1 << 20))
+                    if not chunk:
+                        raise ConnectionError("client closed mid-frame")
+                    chunks.append(chunk)
+                    got += len(chunk)
+                return b"".join(chunks)
+
             def do_POST(self):
+                if self.path == "/update.bin":
+                    # frames are self-delimiting; decode straight off the
+                    # body so only one chunk is transient at a time
+                    delta = wire.decode_stream(
+                        self._read_exact, self.rfile.readinto
+                    )
+                    server.update_parameters(delta)
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 if self.path != "/update":
                     self.send_error(404)
                     return
                 length = int(self.headers.get("Content-Length", 0))
-                delta = pickle.loads(self.rfile.read(length))
+                # legacy-pickle fallback endpoint
+                delta = pickle.loads(self._read_exact(length))
                 server.update_parameters(delta)
                 self.send_response(200)
                 self.send_header("Content-Length", "0")
@@ -121,10 +195,7 @@ class HttpServer(BaseParameterServer):
 
 
 class SocketServer(BaseParameterServer):
-    """Raw-TCP op-code protocol: ``b'g'`` get, ``b'u'`` update, ``b'q'`` bye.
-
-    Frames are length-prefixed pickles (:mod:`elephas_tpu.utils.sockets`).
-    """
+    """Raw-TCP op-code protocol (binary codec fast path + pickle legacy)."""
 
     def __init__(self, weights, mode: str = "asynchronous", port: int = 4000):
         super().__init__(weights, mode, port)
@@ -136,14 +207,32 @@ class SocketServer(BaseParameterServer):
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                sock = self.request
+                sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
                 while True:
-                    op = self.request.recv(1)
+                    op = sock.recv(1)
                     if not op or op == b"q":
                         return
-                    if op == b"g":
-                        sockets.send(self.request, ps.get_parameters())
-                    elif op == b"u":
-                        delta = sockets.receive(self.request)
+                    if op == b"?":
+                        sock.sendall(bytes([PROTOCOL_VERSION]))
+                    elif op == b"G":
+                        comp = sockets.read_exact(sock, 1)
+                        frames = ps.encode_parameters(
+                            "int8" if comp == b"\x01" else "none"
+                        )
+                        sockets.send_frames(sock, frames)
+                    elif op == b"U":
+                        delta = wire.decode_stream(
+                            sockets.reader(sock), sockets.reader_into(sock)
+                        )
+                        ps.update_parameters(delta)
+                        sock.sendall(b"k")
+                    elif op == b"g":  # legacy-pickle fallback
+                        sockets.send(sock, ps.get_parameters())
+                    elif op == b"u":  # legacy-pickle fallback
+                        delta = sockets.receive(sock)
                         ps.update_parameters(delta)
                     else:
                         return

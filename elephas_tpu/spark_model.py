@@ -23,6 +23,8 @@ from __future__ import annotations
 import json
 import logging
 import os
+import queue
+import threading
 
 import numpy as np
 
@@ -32,6 +34,52 @@ from elephas_tpu.utils import rdd_utils
 from elephas_tpu.worker import MeshRunner, MODES, FREQUENCIES
 
 logger = logging.getLogger(__name__)
+
+
+class _WeightPublisher:
+    """Latest-wins background publication to the in-process weight
+    store (ISSUE 2): the epoch loop hands off a snapshot and keeps
+    training while ``set_weights`` runs on a daemon thread. The queue
+    holds ONE snapshot — a slow store drops intermediate epochs rather
+    than stalling training (external pollers see a bounded-stale view;
+    the end-of-fit publish is always synchronous and final)."""
+
+    _STOP = object()
+
+    def __init__(self, server):
+        self._server = server
+        self._q: queue.Queue = queue.Queue(maxsize=1)
+        self._thread = threading.Thread(
+            target=self._run, name="elephas-ps-publish", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                return
+            try:
+                self._server.set_weights(item)
+            except Exception:  # publication is best-effort mid-fit
+                logger.exception("background weight publication failed")
+
+    def publish(self, weights) -> None:
+        try:
+            self._q.put_nowait(weights)
+        except queue.Full:  # replace the stale queued snapshot
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self._q.put_nowait(weights)
+            except queue.Full:
+                pass  # a concurrent publish won the slot; equally fresh
+
+    def stop(self) -> None:
+        self._q.put(self._STOP)  # behind any queued snapshot: drains first
+        self._thread.join(timeout=30)
 
 
 class SparkModel:
@@ -45,6 +93,7 @@ class SparkModel:
         custom_objects: dict | None = None,
         batch_size: int = 32,
         port: int = 4000,
+        ps_overlap: bool | None = None,
         model_parallel: int = 1,
         pipeline_parallel: int = 1,
         pipeline_microbatches: int = 4,
@@ -84,6 +133,14 @@ class SparkModel:
         self.custom_objects = custom_objects
         self.batch_size = batch_size
         self.port = port
+        # overlapped publication (ISSUE 2): epoch-boundary set_weights on
+        # the external store rides a background thread instead of
+        # blocking the epoch loop. Default: on for async/hogwild, OFF
+        # for synchronous (which stays bit-exact and blocking).
+        self.ps_overlap = (
+            mode != "synchronous" if ps_overlap is None else bool(ps_overlap)
+        )
+        self._publisher = None
         self.model_parallel = int(model_parallel)
         self.pipeline_parallel = int(pipeline_parallel)
         self.pipeline_microbatches = int(pipeline_microbatches)
@@ -251,6 +308,7 @@ class SparkModel:
             "num_workers": self.num_workers,
             "batch_size": self.batch_size,
             "port": self.port,
+            "ps_overlap": self.ps_overlap,
             "model_parallel": self.model_parallel,
             "pipeline_parallel": self.pipeline_parallel,
             "pipeline_microbatches": self.pipeline_microbatches,
@@ -284,15 +342,32 @@ class SparkModel:
             self._master_network.get_weights(), mode=self.mode, port=self.port
         )
         self._parameter_server.start()
+        if self.ps_overlap and self.mode != "synchronous":
+            self._publisher = _WeightPublisher(self._parameter_server)
 
     def stop_server(self) -> None:
+        self._stop_publisher()
         if self._parameter_server is not None:
             self._parameter_server.stop()
             self._parameter_server = None
 
-    def _publish_weights(self) -> None:
-        if self._parameter_server is not None:
-            self._parameter_server.set_weights(self._get_runner().host_weights())
+    def _stop_publisher(self) -> None:
+        if self._publisher is not None:
+            self._publisher.stop()
+            self._publisher = None
+
+    def _publish_weights(self, final: bool = False) -> None:
+        if self._parameter_server is None:
+            return
+        weights = self._get_runner().host_weights()
+        if self._publisher is not None and not final:
+            self._publisher.publish(weights)
+            return
+        if final:
+            # drain the background publisher so the synchronous final
+            # publish can't be overwritten by a stale queued snapshot
+            self._stop_publisher()
+        self._parameter_server.set_weights(weights)
 
     # -- training ------------------------------------------------------
 
@@ -648,7 +723,7 @@ class SparkModel:
                             json.dumps({"final": True, "history": history})
                             + "\n"
                         )
-            self._publish_weights()
+            self._publish_weights(final=True)
         finally:
             self.stop_server()
         self.training_histories.append(history)
@@ -1010,6 +1085,7 @@ def load_spark_model(file_name: str) -> SparkModel:
         num_workers=config.get("num_workers"),
         batch_size=config.get("batch_size", 32),
         port=config.get("port", 4000),
+        ps_overlap=config.get("ps_overlap"),
         model_parallel=config.get("model_parallel", 1),
         pipeline_parallel=config.get("pipeline_parallel", 1),
         pipeline_microbatches=config.get("pipeline_microbatches", 4),

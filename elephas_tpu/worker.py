@@ -37,7 +37,11 @@ Mode semantics (see SURVEY.md §2a):
 
 from __future__ import annotations
 
+import collections
 import logging
+import queue
+import threading
+from concurrent.futures import Future
 from functools import partial
 
 import jax
@@ -911,6 +915,76 @@ class MeshRunner(KerasIntrospection):
         return out
 
 
+# -- overlapped parameter sync (ISSUE 2 tentpole, part 3) ----------------
+
+
+class OverlappedSync:
+    """Background push(delta)/pull(weights) window for async/hogwild
+    workers: one daemon thread owns the parameter client (a single
+    connection — wire ops stay serialized), so a sync round overlaps the
+    next period's compute instead of blocking it.
+
+    Staleness bound: at most ``staleness`` rounds may be in flight;
+    :meth:`submit` blocks until the oldest lands once the window is
+    full. ``synchronous`` mode never routes through this class — it
+    stays blocking and bit-exact.
+    """
+
+    def __init__(self, client, staleness: int = 1):
+        self.client = client
+        self.staleness = max(1, int(staleness))
+        self._queue: queue.Queue = queue.Queue()
+        self._pending: collections.deque[Future] = collections.deque()
+        self.max_in_flight = 0  # high-water mark (tested staleness bound)
+        self._thread = threading.Thread(
+            target=self._run, name="elephas-ps-sync", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            delta, fut = item
+            try:
+                if delta is not None:
+                    self.client.update_parameters(delta)
+                fut.set_result(self.client.get_parameters())
+            except BaseException as e:  # surfaced at submit/drain
+                fut.set_exception(e)
+
+    def submit(self, delta) -> Future:
+        """Queue one round (push ``delta``, then pull fresh weights)."""
+        while len(self._pending) >= self.staleness:
+            self._pending.popleft().result()  # staleness bound: block
+        fut: Future = Future()
+        self._queue.put((delta, fut))
+        self._pending.append(fut)
+        self.max_in_flight = max(self.max_in_flight, len(self._pending))
+        return fut
+
+    def freshest(self):
+        """Newest completed pull (dropping older ones), or None if every
+        in-flight round is still on the wire — the caller then continues
+        from its local weights, Hogwild-style."""
+        newest = None
+        while self._pending and self._pending[0].done():
+            newest = self._pending.popleft().result()
+        return newest
+
+    def drain(self):
+        """Wait for every in-flight round; returns the last pull."""
+        out = None
+        while self._pending:
+            out = self._pending.popleft().result()
+        return out
+
+    def close(self) -> None:
+        self._queue.put(None)
+        self._thread.join(timeout=30)
+
+
 # -- executor-side worker classes (reference API parity) ----------------
 
 
@@ -992,6 +1066,14 @@ class AsynchronousSparkWorker(SparkWorker):
     :mod:`elephas_tpu.parameter` client, so it works against a weight
     store on another host over DCN. ``frequency='epoch'`` syncs once per
     epoch, ``'batch'`` once per mini-batch.
+
+    ISSUE 2 knobs: ``compression``/``topk`` select the binary codec's
+    int8 quantization (with error-feedback residuals held by the
+    client) and top-k delta sparsification; ``overlap=True`` routes
+    sync rounds through :class:`OverlappedSync` so the wire rides
+    under the next period's compute, trading a bounded ``staleness``
+    (in sync periods) for throughput — the async/hogwild trade, never
+    applied to the synchronous worker.
     """
 
     def __init__(
@@ -1007,6 +1089,11 @@ class AsynchronousSparkWorker(SparkWorker):
         master_loss="categorical_crossentropy",
         master_metrics=None,
         custom_objects: dict | None = None,
+        compression: str = "none",
+        topk: float | None = None,
+        pull_compression: str | None = None,
+        overlap: bool = False,
+        staleness: int = 1,
     ):
         super().__init__(
             json_model,
@@ -1023,11 +1110,26 @@ class AsynchronousSparkWorker(SparkWorker):
         self.parameter_server_mode = parameter_server_mode
         self.master = master
         self.port = port
+        self.compression = compression
+        self.topk = topk
+        self.pull_compression = pull_compression
+        self.overlap = bool(overlap)
+        self.staleness = max(1, int(staleness))
 
     def _client(self, model=None):
         from elephas_tpu.parameter.client import HttpClient, SocketClient
 
         if self.parameter_server_mode == "native":
+            if (
+                self.compression != "none"
+                or self.topk is not None
+                or self.pull_compression not in (None, "none")
+            ):
+                raise ValueError(
+                    "the native parameter server speaks raw float32 "
+                    "frames — compression/topk need "
+                    "parameter_server_mode='http' or 'socket'"
+                )
             from elephas_tpu.parameter.native import NativeClient, _Flattener
 
             host, _, p = (self.master or "127.0.0.1").partition(":")
@@ -1041,7 +1143,26 @@ class AsynchronousSparkWorker(SparkWorker):
                 f"parameter_server_mode must be 'http', 'socket' or "
                 f"'native', got {self.parameter_server_mode!r}"
             )
-        return cls(self.master, self.port)
+        return cls(
+            self.master, self.port,
+            compression=self.compression, topk=self.topk,
+            pull_compression=self.pull_compression,
+        )
+
+    def _periods(self, x, y, epochs: int, batch_size: int):
+        """The sync-period stream: whole epochs or mini-batches."""
+        for _ in range(epochs):
+            if self.frequency == "epoch":
+                yield x, y
+            else:
+                for start in range(0, len(x), batch_size):
+                    yield x[start : start + batch_size], y[start : start + batch_size]
+
+    def _fit_period(self, model, xp, yp, batch_size: int) -> None:
+        if self.frequency == "epoch":
+            model.fit(xp, yp, epochs=1, batch_size=batch_size, verbose=0)
+        else:
+            model.train_on_batch(xp, yp)
 
     def train(self, data_iterator):
         from elephas_tpu.utils.functional_utils import subtract_params
@@ -1054,27 +1175,50 @@ class AsynchronousSparkWorker(SparkWorker):
         epochs = self.train_config.get("epochs", 1)
         batch_size = self.train_config.get("batch_size", 32)
         try:
-            for _ in range(epochs):
-                if self.frequency == "epoch":
+            if self.overlap:
+                self._train_overlapped(
+                    model, client, x, y, epochs, batch_size
+                )
+            else:
+                for xp, yp in self._periods(x, y, epochs, batch_size):
                     before = client.get_parameters()
                     model.set_weights(before)
-                    model.fit(x, y, epochs=1, batch_size=batch_size, verbose=0)
-                    # server applies weights += delta, so the delta must be
-                    # the descent step (after − before)
+                    self._fit_period(model, xp, yp, batch_size)
+                    # server applies weights += delta, so the delta must
+                    # be the descent step (after − before)
                     client.update_parameters(
                         subtract_params(model.get_weights(), before)
                     )
-                else:  # per-batch
-                    for start in range(0, len(x), batch_size):
-                        xb = x[start : start + batch_size]
-                        yb = y[start : start + batch_size]
-                        before = client.get_parameters()
-                        model.set_weights(before)
-                        model.train_on_batch(xb, yb)
-                        client.update_parameters(
-                            subtract_params(model.get_weights(), before)
-                        )
         finally:
             if hasattr(client, "close"):
                 client.close()
         yield model.get_weights(), {}
+
+    def _train_overlapped(self, model, client, x, y, epochs, batch_size):
+        """Double-buffered loop: period ``i``'s compute overlaps round
+        ``i-1``'s push+pull; adopted weights are stale by at most
+        ``staleness`` periods (else the worker continues from its own
+        local weights, Hogwild-style)."""
+        from elephas_tpu.utils.functional_utils import subtract_params
+
+        sync = OverlappedSync(client, self.staleness)
+        try:
+            before = client.get_parameters()  # initial pull: blocking
+            model.set_weights(before)
+            for xp, yp in self._periods(x, y, epochs, batch_size):
+                self._fit_period(model, xp, yp, batch_size)
+                after = model.get_weights()
+                sync.submit(subtract_params(after, before))
+                fresh = sync.freshest()
+                if fresh is not None:
+                    before = fresh
+                    model.set_weights(fresh)
+                else:
+                    # round still on the wire: continue from local
+                    # weights (Hogwild-style), no extra copies
+                    before = after
+            final = sync.drain()  # every push acked before we report
+            if final is not None:
+                model.set_weights(final)
+        finally:
+            sync.close()

@@ -31,8 +31,48 @@ from jax.extend.backend import clear_backends
 
 clear_backends()
 
+import signal
+import threading
+
 import numpy as np
 import pytest
+
+# modules under this per-test deadline: everything that opens parameter-
+# server sockets (a hung read must FAIL the test, not hang tier-1; the
+# image has no pytest-timeout, so SIGALRM does the job)
+_PS_DEADLINE_MODULES = (
+    "test_parameter_server",
+    "test_native_ps",
+    "test_ps_codec",
+    "test_ps_overlap",
+)
+PS_TEST_DEADLINE_S = 120
+
+
+@pytest.fixture(autouse=True)
+def _ps_socket_deadline(request):
+    mod = getattr(request.module, "__name__", "")
+    applies = any(mod.endswith(m) for m in _PS_DEADLINE_MODULES)
+    if (
+        not applies
+        or not hasattr(signal, "SIGALRM")
+        or threading.current_thread() is not threading.main_thread()
+    ):
+        yield
+        return
+
+    def _expired(signum, frame):
+        raise TimeoutError(
+            f"PS socket test exceeded the {PS_TEST_DEADLINE_S}s deadline"
+        )
+
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(PS_TEST_DEADLINE_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture(scope="session")
