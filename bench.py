@@ -796,6 +796,74 @@ def measure_ps(transport: str, rounds: int, rows: int, epochs: int):
     }
 
 
+def measure_faults(transport: str, rows: int, epochs: int, seed: int):
+    """``--preset faults`` (ISSUE 3): recovery time and degraded-mode
+    throughput under a seeded chaos plan — PS kill+restart mid-epoch
+    (journal replay on the same port), a seeded fraction of update
+    frames duplicated on the wire (sequence-ID dedup makes them
+    no-ops), and periodic injected socket delays — against a fault-free
+    run of the same seeded data/model. Every number comes from real
+    counters and timestamps (server apply counts across incarnations,
+    client resend/lost counters, kill→first-post-restart-apply clock);
+    the same credibility floor as every other preset gates the JSON.
+    """
+    from elephas_tpu.fault.harness import measure_faults as run
+
+    clean, faulted, plan = run(
+        transport, rows=rows, epochs=epochs, seed=seed
+    )
+    for name, rec in (("clean", clean), ("faulted", faulted)):
+        if not (rec["dt_s"] > MIN_CREDIBLE_DT):
+            raise ImplausibleTiming(
+                f"faults {name} window {rec['dt_s']:.4f}s below the "
+                f"{MIN_CREDIBLE_DT}s credibility floor"
+            )
+    if not faulted["kills"]:
+        raise ImplausibleTiming(
+            "fault plan never fired: the PS was not killed (training "
+            "finished before the trigger) — lower kill_after_updates "
+            "or raise --ps-rows"
+        )
+    if faulted["recovery_s"] is None:
+        raise ImplausibleTiming(
+            "PS restarted but no post-restart update was observed — "
+            "recovery cannot be reported from real counters"
+        )
+    degradation = faulted["samples_per_s"] / clean["samples_per_s"]
+    log.info(
+        "faults [%s]: clean %.0f samples/s, faulted %.0f samples/s "
+        "(%.2fx), recovery %.2fs, %d/%d updates applied, %d dup frames "
+        "sent / %d skipped, %d resent, %d lost",
+        transport, clean["samples_per_s"], faulted["samples_per_s"],
+        degradation, faulted["recovery_s"], faulted["updates_applied"],
+        clean["updates_applied"], faulted["duplicates_sent"],
+        faulted["duplicates_skipped"], faulted["updates_resent"],
+        faulted["updates_lost_final"],
+    )
+    return {
+        "metric": f"PS crash recovery time ({transport}, journal replay)",
+        "value": round(faulted["recovery_s"], 4),
+        "unit": "s",
+        "vs_baseline": round(degradation, 4),  # degraded-mode throughput
+        "clean_sps": round(clean["samples_per_s"], 1),
+        "faulted_sps": round(faulted["samples_per_s"], 1),
+        "recovery_s": round(faulted["recovery_s"], 4),
+        "restart_delay_s": plan.restart_delay_s,
+        "updates_applied": faulted["updates_applied"],
+        "updates_expected": clean["updates_applied"],
+        "duplicates_sent": faulted["duplicates_sent"],
+        "duplicates_skipped": faulted["duplicates_skipped"],
+        "updates_resent": faulted["updates_resent"],
+        "updates_lost_final": faulted["updates_lost_final"],
+        "kills": faulted["kills"],
+        "restarts": faulted["restarts"],
+        "journal_restored": faulted["journal_restored"],
+        "seed": seed,
+        "rows": rows,
+        "epochs": epochs,
+    }
+
+
 def measure_keras_fit(model, x, y, batch_size, epochs):
     """Stock keras ``model.fit`` images/sec (the glue-path floor only —
     numpy fed per batch; NOT the honest baseline)."""
@@ -809,13 +877,20 @@ def measure_keras_fit(model, x, y, batch_size, epochs):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset",
-                   choices=["auto", "full", "tiny", "serving", "ps"],
+                   choices=["auto", "full", "tiny", "serving", "ps",
+                            "faults"],
                    default="auto",
                    help="serving = the continuous-batching engine bench "
                         "(aggregate tok/s, per-request p50/p99 latency, "
                         "slot occupancy); ps = the parameter-sync wire "
                         "bench (bytes-per-sync, sync latency, async "
-                        "worker throughput vs the pickle baseline)")
+                        "worker throughput vs the pickle baseline); "
+                        "faults = the chaos bench (PS kill+restart "
+                        "recovery time, duplicate-frame dedup, degraded "
+                        "throughput vs fault-free)")
+    p.add_argument("--faults-seed", type=int, default=0,
+                   help="faults preset: fault-plan seed (same seed = "
+                        "same kill point, duplicates, delays)")
     p.add_argument("--ps-transport", choices=["socket", "http"],
                    default="socket",
                    help="ps preset: which server/client pair to measure")
@@ -893,6 +968,22 @@ def main():
             )
         except ImplausibleTiming as e:
             log.error("ps bench implausible: %s — no JSON", e)
+            sys.exit(1)
+        print(json.dumps(out))
+        return
+
+    if args.preset == "faults":
+        # loopback chaos run (ISSUE 3) — like ps, no mesh and no TPU
+        # probe; reuses the --ps-rows/--ps-epochs/--ps-transport knobs
+        try:
+            out = measure_faults(
+                args.ps_transport,
+                max(128, args.ps_rows),
+                max(1, args.ps_epochs),
+                args.faults_seed,
+            )
+        except ImplausibleTiming as e:
+            log.error("faults bench implausible: %s — no JSON", e)
             sys.exit(1)
         print(json.dumps(out))
         return

@@ -1,0 +1,368 @@
+"""Chaos-injection harness (ISSUE 3 tentpole, part 3).
+
+Executable fault machinery around a :class:`~elephas_tpu.fault.plan.
+FaultPlan`: a :class:`RestartablePS` that can crash-and-recover a live
+parameter server on its original port (journal replay), a
+:class:`PSKiller` that triggers the crash mid-training and measures
+recovery from real server counters, and :func:`run_chaos_training`,
+which drives a real ``AsynchronousSparkWorker`` against all of it —
+shared by ``tests/test_fault_tolerance.py`` and ``bench.py --preset
+faults`` so the tested faults and the benchmarked faults are the same
+code path.
+
+Everything here is deterministic given ``(plan.seed, data seed)`` up to
+scheduler timing: the data, the model init, the duplicate schedule, and
+the kill trigger (an applied-update count, not a wall-clock timer) are
+all seeded; only the exact interleaving of the kill with the worker's
+in-flight op varies, which is precisely the nondeterminism the
+recovery machinery must absorb.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from elephas_tpu.fault.plan import FaultPlan
+from elephas_tpu.utils import sockets
+
+logger = logging.getLogger(__name__)
+
+
+class RestartablePS:
+    """Owns a (journaled) parameter server that can be killed like a
+    crash — no terminal journal flush — and restarted on the SAME port,
+    replaying the journal.
+
+    Counters (`updates_applied`, `updates_duplicate`) accumulate across
+    incarnations so callers read totals, not just the survivor's.
+    """
+
+    def __init__(
+        self,
+        server_cls,
+        weights,
+        mode: str = "asynchronous",
+        journal_dir: str | None = None,
+        journal_every: int = 2,
+        lease_timeout: float = 30.0,
+    ):
+        self._server_cls = server_cls
+        self._weights = [np.asarray(w) for w in weights]
+        self._mode = mode
+        self._journal_dir = journal_dir
+        self._journal_every = journal_every
+        self._lease_timeout = lease_timeout
+        self._dead_counts = {"updates_applied": 0, "updates_duplicate": 0}
+        self.kills = 0
+        self.restarts = 0
+        self.t_killed: float | None = None
+        self.t_recovered: float | None = None
+        self.server = self._spawn(port=0)
+        self.server.start()
+        self.port = self.server.port
+
+    def _spawn(self, port: int):
+        return self._server_cls(
+            self._weights,
+            mode=self._mode,
+            port=port,
+            journal_dir=self._journal_dir,
+            journal_every=self._journal_every,
+            lease_timeout=self._lease_timeout,
+        )
+
+    def _absorb_counts(self, server) -> None:
+        self._dead_counts["updates_applied"] += server.updates_applied
+        self._dead_counts["updates_duplicate"] += server.updates_duplicate
+
+    def kill(self) -> None:
+        """Crash the server: stop serving WITHOUT a terminal journal
+        flush, so recovery replays the last periodic snapshot (the
+        honest crash case — a clean ``stop()`` would hide journal lag)."""
+        server, self.server = self.server, None
+        if server is None:
+            return
+        self.t_killed = time.monotonic()
+        self.kills += 1
+        server.stop(flush_journal=False)
+        # absorb AFTER stop: an op in flight at the kill may still
+        # complete its apply while connections sever
+        self._absorb_counts(server)
+        logger.info("chaos: parameter server killed on port %d", self.port)
+
+    def restart(self) -> None:
+        server = self._spawn(port=self.port)
+        server.start()
+        self.server = server
+        self.restarts += 1
+        logger.info(
+            "chaos: parameter server restarted on port %d (journal "
+            "restored: %s)", self.port, server.restored_from_journal,
+        )
+
+    def counters(self) -> dict[str, int]:
+        out = dict(self._dead_counts)
+        if self.server is not None:
+            out["updates_applied"] += self.server.updates_applied
+            out["updates_duplicate"] += self.server.updates_duplicate
+        return out
+
+    @property
+    def recovery_s(self) -> float | None:
+        """Kill → first post-restart applied update, from real
+        timestamps (None until both happened)."""
+        if self.t_killed is None or self.t_recovered is None:
+            return None
+        return self.t_recovered - self.t_killed
+
+    def get_parameters(self):
+        return self.server.get_parameters()
+
+    def stop(self) -> None:
+        if self.server is not None:
+            self._absorb_counts(self.server)
+            self.server.stop()
+            self.server = None
+
+
+class PSKiller(threading.Thread):
+    """Kills the PS once it has applied ``after_updates`` more updates
+    (beyond ``baseline``), restarts it after ``restart_delay_s``, and
+    stamps ``ps.t_recovered`` at the first update the reborn server
+    applies."""
+
+    def __init__(
+        self,
+        ps: RestartablePS,
+        after_updates: int,
+        restart_delay_s: float = 0.5,
+        baseline: int = 0,
+        poll_s: float = 0.01,
+    ):
+        super().__init__(name="elephas-chaos-pskiller", daemon=True)
+        self.ps = ps
+        self.after_updates = int(after_updates)
+        self.restart_delay_s = float(restart_delay_s)
+        self.baseline = int(baseline)
+        self.poll_s = float(poll_s)
+        self._cancel = threading.Event()
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    def _wait_for_updates(self, threshold: int) -> bool:
+        while not self._cancel.is_set():
+            server = self.ps.server
+            if server is not None and server.updates_applied >= threshold:
+                return True
+            time.sleep(self.poll_s)
+        return False
+
+    def run(self) -> None:
+        if not self._wait_for_updates(self.baseline + self.after_updates):
+            return
+        self.ps.kill()
+        time.sleep(self.restart_delay_s)
+        self.ps.restart()
+        if self._wait_for_updates(1):
+            self.ps.t_recovered = time.monotonic()
+
+
+# -- end-to-end chaos training -------------------------------------------
+
+
+def _chaos_data(seed: int, rows: int, d: int = 16, k: int = 3):
+    """Seeded separable blobs (the conftest recipe, self-contained so
+    bench runs outside pytest)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)) * 2.0
+    y = rng.integers(0, k, size=rows)
+    x = (centers[y] + rng.normal(size=(rows, d)) * 0.6).astype(np.float32)
+    return x, y.astype(np.int32), d, k
+
+
+def _chaos_model(seed: int, d: int, k: int):
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    import keras
+
+    keras.utils.set_random_seed(seed)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((d,)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(k, activation="softmax"),
+        ]
+    )
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    return model
+
+
+def run_chaos_training(
+    transport: str = "socket",
+    rows: int = 256,
+    epochs: int = 2,
+    batch_size: int = 64,
+    seed: int = 0,
+    plan: FaultPlan | None = None,
+    journal_dir: str | None = None,
+    journal_every: int = 2,
+    mode: str = "asynchronous",
+    ps_retries: int = 8,
+) -> dict:
+    """One real async-worker training run under ``plan`` (or fault-free
+    when ``plan`` is None) against a restartable, journaled PS.
+
+    Returns real counters and timings: wall-clock + samples/sec of the
+    timed (post-warmup) window, kill/restart/recovery timestamps,
+    applied/duplicate counts aggregated across server incarnations, and
+    the worker clients' lost/resent counters — plus the final server
+    weights so callers can evaluate convergence.
+    """
+    from elephas_tpu.parameter.server import HttpServer, SocketServer
+    from elephas_tpu.worker import AsynchronousSparkWorker
+
+    x, y, d, k = _chaos_data(seed, rows)
+    model = _chaos_model(seed, d, k)
+    server_cls = {"socket": SocketServer, "http": HttpServer}[transport]
+    ps = RestartablePS(
+        server_cls,
+        model.get_weights(),
+        mode=mode,
+        journal_dir=journal_dir,
+        journal_every=journal_every,
+    )
+    worker = AsynchronousSparkWorker(
+        model.to_json(),
+        train_config={"epochs": epochs, "batch_size": batch_size},
+        frequency="batch",
+        parameter_server_mode=transport,
+        master=f"127.0.0.1:{ps.port}",
+        master_optimizer="adam",
+        master_loss="sparse_categorical_crossentropy",
+        ps_retries=ps_retries,
+    )
+    clients: list = []
+    real_client = worker._client
+
+    def chaotic_client(model=None):
+        client = real_client(model)
+        if plan is not None and plan.duplicate_fraction > 0.0:
+            client.chaos_duplicate = plan.duplicate
+        clients.append(client)
+        return client
+
+    worker._client = chaotic_client
+
+    killer = None
+    previous_hook = None
+    hook_installed = False
+    try:
+        # warmup OUTSIDE the timed window and BEFORE any chaos: keras
+        # compile + wire negotiation must not pollute throughput or the
+        # kill trigger
+        list(worker.train(iter(zip(x[:batch_size], y[:batch_size]))))
+        baseline_updates = ps.counters()["updates_applied"]
+
+        if plan is not None and plan.kill_ps_after_updates is not None:
+            killer = PSKiller(
+                ps,
+                plan.kill_ps_after_updates,
+                restart_delay_s=plan.restart_delay_s,
+                baseline=baseline_updates,
+            )
+            killer.start()
+        if plan is not None:
+            hook = plan.make_socket_hook()
+            if hook is not None:
+                previous_hook = sockets.set_fault_hook(hook)
+                hook_installed = True
+
+        t0 = time.perf_counter()
+        list(worker.train(iter(zip(x, y))))
+        dt = time.perf_counter() - t0
+    finally:
+        if hook_installed:
+            sockets.set_fault_hook(previous_hook)
+        if killer is not None:
+            killer.cancel()
+            killer.join(timeout=30)
+    try:
+        counters = ps.counters()
+        final_weights = ps.get_parameters()
+    finally:
+        ps.stop()
+
+    return {
+        "transport": transport,
+        "rows": rows,
+        "epochs": epochs,
+        "seed": seed,
+        "dt_s": dt,
+        "samples_per_s": rows * epochs / dt,
+        "updates_applied": counters["updates_applied"] - baseline_updates,
+        "duplicates_skipped": counters["updates_duplicate"],
+        "updates_resent": sum(c.updates_resent for c in clients),
+        "duplicates_sent": sum(c.chaos_dups_sent for c in clients),
+        "updates_lost_final": sum(
+            getattr(c, "updates_lost", 0) for c in clients
+        ),
+        "kills": ps.kills,
+        "restarts": ps.restarts,
+        "recovery_s": ps.recovery_s,
+        "journal_restored": (
+            ps.restarts > 0 and journal_dir is not None
+        ),
+        "final_weights": final_weights,
+        "data": (x, y),
+    }
+
+
+def measure_faults(
+    transport: str = "socket",
+    rows: int = 256,
+    epochs: int = 2,
+    batch_size: int = 64,
+    seed: int = 0,
+    kill_after_updates: int | None = None,
+    restart_delay_s: float = 0.75,
+    duplicate_fraction: float = 0.25,
+):
+    """``bench.py --preset faults`` backend: one fault-free run and one
+    chaos run (PS kill+restart mid-epoch, a seeded fraction of update
+    frames duplicated on the wire, periodic wire delays) on the same
+    seeded data/model. Returns ``(clean, faulted, plan)`` — the caller
+    owns the JSON contract and the credibility gate."""
+    from elephas_tpu.fault.plan import SocketFaults
+
+    clean = run_chaos_training(
+        transport, rows=rows, epochs=epochs, batch_size=batch_size,
+        seed=seed, plan=None,
+    )
+    if kill_after_updates is None:
+        # land the kill mid-epoch, around a third into the sync stream
+        periods = max(1, -(-rows // batch_size)) * epochs
+        kill_after_updates = max(2, periods // 3)
+    plan = FaultPlan(
+        seed=seed,
+        kill_ps_after_updates=kill_after_updates,
+        restart_delay_s=restart_delay_s,
+        duplicate_fraction=duplicate_fraction,
+        socket_faults=SocketFaults(delay_every=13, delay_ms=4.0),
+    )
+    with tempfile.TemporaryDirectory(prefix="elephas-faults-") as jdir:
+        faulted = run_chaos_training(
+            transport,
+            rows=rows,
+            epochs=epochs,
+            batch_size=batch_size,
+            seed=seed,
+            plan=plan,
+            journal_dir=jdir,
+        )
+    return clean, faulted, plan

@@ -15,6 +15,12 @@ ISSUE 2 replaced the pickled wire format with a binary codec
 int8 quantization with error-feedback residuals, optional top-k delta
 sparsification) negotiated per connection, with pickle kept as the
 legacy fallback.
+
+ISSUE 3 made the servers journaled/restartable and the apply path
+idempotent via client-assigned sequence IDs
+(:mod:`elephas_tpu.parameter.journal`; protocol version 2 adds the
+sequenced-update, heartbeat, and status ops), turning the clients'
+at-least-once retries into effectively-once delivery.
 """
 
 from elephas_tpu.parameter.server import (  # noqa: F401
@@ -30,4 +36,8 @@ from elephas_tpu.parameter.client import (  # noqa: F401
 from elephas_tpu.parameter.codec import (  # noqa: F401
     ErrorFeedback,
     WireCodec,
+)
+from elephas_tpu.parameter.journal import (  # noqa: F401
+    load_journal,
+    save_journal,
 )

@@ -13,6 +13,19 @@ the negotiated fallback against legacy servers (detected per client on
 first use: a 404 on ``/parameters.bin``, or a closed socket after the
 ``b'?'`` capability probe).
 
+ISSUE 3 (fault tolerance): every client owns a ``client_id`` and stamps
+each push with a **monotonic sequence ID** when the server speaks
+protocol ≥ 2 — the server skips any ``(client, seq)`` it already
+applied, so the at-least-once retry/resend machinery below becomes
+effectively-once end to end. On a version-2 socket server, pushes that
+were in flight when a connection died are **resent** (bounded by
+``MAX_RESEND``) instead of merely counted: ``updates_lost`` rises when
+a connection drops with unacked pushes and drains back as the resends
+are acked (``updates_resent`` counts them). Unsequenced (legacy)
+connections keep the old counted-and-logged behavior — resending there
+could double-apply. ``heartbeat()`` refreshes this worker's lease and
+``status()`` fetches the server's membership/counters JSON.
+
 ``bytes_sent`` / ``bytes_received`` count payload bytes on the wire so
 callers (``bench.py --preset ps``) can report bytes-per-sync honestly.
 """
@@ -20,14 +33,28 @@ callers (``bench.py --preset ps``) can report bytes-per-sync honestly.
 from __future__ import annotations
 
 import http.client
+import json
 import logging
+import os
 import pickle
 import socket
+import struct
+import uuid
+from collections import deque
 
 from elephas_tpu.parameter import codec as wire
 from elephas_tpu.utils import sockets
 
 logger = logging.getLogger(__name__)
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+# a reconnect may carry at most this many unacked pushes over for
+# resend; anything beyond stays lost (and counted) — an unbounded
+# resend queue would let a long outage buffer arbitrary memory
+MAX_RESEND = 64
 
 
 def _split_master(master: str | None, port: int) -> tuple[str, int]:
@@ -38,15 +65,24 @@ def _split_master(master: str | None, port: int) -> tuple[str, int]:
     return host or "127.0.0.1", int(p or port)
 
 
+def default_client_id() -> str:
+    """Stable-enough worker identity: host + pid + random tail (two
+    workers in one process stay distinct; a restarted worker PROCESS
+    gets a fresh id on purpose — its sequence counter restarts at 0,
+    and reusing the old id would make the server drop everything)."""
+    return f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+
+
 class BaseParameterClient:
     """Shared wire-codec state: compression knobs, error feedback,
-    byte counters, and the legacy-fallback flag."""
+    byte counters, sequence IDs, and the legacy-fallback flag."""
 
     def __init__(
         self,
         compression: str = "none",
         topk: float | None = None,
         pull_compression: str | None = None,
+        client_id: str | None = None,
     ):
         for c in (compression, pull_compression):
             if c is not None and c not in wire.COMPRESSIONS:
@@ -71,8 +107,23 @@ class BaseParameterClient:
             else None
         )
         self._binary: bool | None = None  # None until negotiated
+        self.client_id = client_id or default_client_id()
+        self._seq = 0  # next sequence ID to assign (monotonic)
         self.bytes_sent = 0
         self.bytes_received = 0
+        self.updates_resent = 0  # unacked pushes safely replayed
+        self.updates_duplicate = 0  # resends the server dedup-skipped
+        # chaos-injection hook (elephas_tpu.fault): when set, called as
+        # hook(seq) after a successful sequenced push; returning True
+        # makes the client resend the identical frame — the harness's
+        # wire-level duplicate, exercising the server's dedup path
+        self.chaos_duplicate = None
+        self.chaos_dups_sent = 0
+
+    def _next_seq(self) -> int:
+        seq = self._seq
+        self._seq += 1
+        return seq
 
     def reset_counters(self) -> None:
         self.bytes_sent = 0
@@ -101,8 +152,9 @@ class HttpClient(BaseParameterClient):
         pull_compression: str | None = None,
         timeout: float = sockets.IO_TIMEOUT,
         retries: int = 3,
+        client_id: str | None = None,
     ):
-        super().__init__(compression, topk, pull_compression)
+        super().__init__(compression, topk, pull_compression, client_id)
         self.host, self.port = _split_master(master, port)
         self.master_url = f"http://{self.host}:{self.port}"
         self.timeout = timeout
@@ -192,40 +244,58 @@ class HttpClient(BaseParameterClient):
         return pickle.loads(payload)  # legacy-pickle fallback path
 
     def update_parameters(self, delta) -> None:
-        """Push one delta. Retries make this at-least-once: if the
-        server applied the POST but the response was lost, the resend
-        applies it twice (a doubled additive step) — the async/hogwild
-        trade, chosen over the legacy wire's silent at-most-once."""
+        """Push one delta. Retries make the wire at-least-once; the
+        sequence-ID headers make the APPLY idempotent against a
+        version-2 server (a resent POST whose first copy landed is
+        skipped server-side) — effectively-once end to end. Against a
+        pre-ISSUE-3 binary server the headers are ignored and the old
+        double-apply caveat stands."""
         if self._binary is False and self._feedback is None:
             # known-legacy server + lossless push: pickle the delta
             # directly, skipping a pointless codec encode+decode pass
             self._retry(lambda: self._legacy_update(pickle.dumps(delta)))
             return
         body = self._encode_update(delta)
-        self._retry(lambda: self._update_once(body))
+        seq = self._next_seq()
+        self._retry(lambda: self._update_once(body, seq))
 
-    def _update_once(self, body: bytes) -> None:
+    def _update_once(self, body: bytes, seq: int | None = None) -> None:
         if self._binary is not False:
-            conn = self._connection()
-            conn.request(
-                "POST",
-                "/update.bin",
-                body=body,
-                headers={"Content-Type": "application/octet-stream"},
-            )
-            resp = conn.getresponse()
-            resp.read()
-            if resp.status == 200:
-                self._binary = True
-                self.bytes_sent += len(body)
+            applied = self._post_update_bin(body, seq)
+            if applied is not None:
+                if not applied:
+                    self.updates_duplicate += 1
+                elif self.chaos_duplicate is not None and seq is not None \
+                        and self.chaos_duplicate(seq):
+                    # chaos harness: wire-level duplicate of this frame
+                    self.chaos_dups_sent += 1
+                    if self._post_update_bin(body, seq) is False:
+                        self.updates_duplicate += 1
                 return
-            if resp.status != 404:
-                raise ConnectionError(f"POST /update.bin -> {resp.status}")
             self._binary = False
         # Legacy server: ship the delta AS THE SERVER WILL SEE IT — the
         # locally-decoded frames — so the error-feedback residual
         # (absorbed at encode time) stays exact.
         self._legacy_update(pickle.dumps(wire.decode(body)))
+
+    def _post_update_bin(self, body: bytes, seq: int | None) -> bool | None:
+        """POST /update.bin once. Returns applied?, or None on a 404
+        (legacy server — caller falls back)."""
+        conn = self._connection()
+        headers = {"Content-Type": "application/octet-stream"}
+        if seq is not None:
+            headers["X-Elephas-Client"] = self.client_id
+            headers["X-Elephas-Seq"] = str(seq)
+        conn.request("POST", "/update.bin", body=body, headers=headers)
+        resp = conn.getresponse()
+        resp.read()
+        if resp.status == 200:
+            self._binary = True
+            self.bytes_sent += len(body)
+            return resp.getheader("X-Elephas-Applied", "1") != "0"
+        if resp.status != 404:
+            raise ConnectionError(f"POST /update.bin -> {resp.status}")
+        return None
 
     def _legacy_update(self, payload: bytes) -> None:
         conn = self._connection()
@@ -241,6 +311,48 @@ class HttpClient(BaseParameterClient):
             raise ConnectionError(f"POST /update -> {resp.status}")
         self.bytes_sent += len(payload)
 
+    # -- liveness (ISSUE 3) -------------------------------------------
+
+    def flush(self) -> None:
+        """Confirm delivery of every push. HTTP POSTs are synchronous
+        request/response — nothing can be outstanding — so this is the
+        no-op half of the socket client's contract."""
+
+    def heartbeat(self) -> None:
+        """Refresh this worker's lease on the server. No-op against a
+        known-legacy server (it has no /heartbeat; a 404 per sync
+        period would just churn)."""
+        if self._binary is False:
+            return
+
+        def once():
+            conn = self._connection()
+            conn.request(
+                "POST", "/heartbeat",
+                headers={"X-Elephas-Client": self.client_id,
+                         "Content-Length": "0"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise ConnectionError(f"POST /heartbeat -> {resp.status}")
+
+        self._retry(once)
+
+    def status(self) -> dict:
+        """The server's status JSON (membership, counters, journal)."""
+
+        def once():
+            conn = self._connection()
+            conn.request("GET", "/status")
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status != 200:
+                raise ConnectionError(f"GET /status -> {resp.status}")
+            return json.loads(payload)
+
+        return self._retry(once)
+
 
 class SocketClient(BaseParameterClient):
     def __init__(
@@ -253,16 +365,26 @@ class SocketClient(BaseParameterClient):
         connect_timeout: float = sockets.CONNECT_TIMEOUT,
         io_timeout: float = sockets.IO_TIMEOUT,
         retries: int = 3,
+        client_id: str | None = None,
     ):
-        super().__init__(compression, topk, pull_compression)
+        super().__init__(compression, topk, pull_compression, client_id)
         self.host, self.port = _split_master(master, port)
         self.connect_timeout = connect_timeout
         self.io_timeout = io_timeout
         self.retries = retries
         self._sock = None
-        self._pending_acks = 0
-        self.updates_lost = 0  # unacked pushes dropped with a dead conn
+        self._proto_version = 0
+        # pipelined pushes awaiting their ack: (seq, body) — body kept
+        # only for sequenced pushes, where a post-reconnect resend is
+        # made safe by the server-side dedup
+        self._unacked: deque[tuple[int | None, bytes | None]] = deque()
+        self._resend: deque[tuple[int, bytes]] = deque()
+        self.updates_lost = 0  # unacked pushes in doubt on a dead conn
         self._connect()
+
+    @property
+    def _sequenced(self) -> bool:
+        return self._proto_version >= 2
 
     # -- connection management ----------------------------------------
 
@@ -277,6 +399,7 @@ class SocketClient(BaseParameterClient):
             try:
                 self._sock.sendall(b"?")
                 ver = sockets.read_exact(self._sock, 1)
+                self._proto_version = ver[0]
                 self._binary = ver[0] >= 1
             except (ConnectionError, OSError):
                 self._binary = False
@@ -287,20 +410,61 @@ class SocketClient(BaseParameterClient):
 
     def _reconnect(self, *_args) -> None:
         self._close_sock()
-        if self._pending_acks:
-            # a pipelined update died on the wire before its ack: the
-            # server may never have applied it (and the error-feedback
-            # residual was already absorbed at encode time). Async/
-            # hogwild training tolerates a lost delta statistically, so
-            # this is surfaced loudly rather than fatally.
-            self.updates_lost += self._pending_acks
-            logger.warning(
-                "connection lost with %d unacked update(s) — the "
-                "delta(s) may not have been applied (updates_lost=%d)",
-                self._pending_acks, self.updates_lost,
+        if self._unacked:
+            # pushes died on the wire before their acks: the server may
+            # or may not have applied them. Sequenced frames are queued
+            # for a BOUNDED resend (dedup makes the replay exactly-once
+            # either way) and `updates_lost` drains as their resends are
+            # acked; unsequenced frames stay lost — resending those
+            # could double-apply — and are surfaced loudly, not fatally.
+            resendable = [
+                (s, b) for s, b in self._unacked
+                if s is not None and b is not None
+            ]
+            overflow = max(
+                0, len(self._resend) + len(resendable) - MAX_RESEND
             )
-        self._pending_acks = 0
+            if overflow:
+                resendable = resendable[overflow:]
+            self._resend.extend(resendable)
+            self.updates_lost += len(self._unacked)
+            logger.warning(
+                "connection lost with %d unacked update(s); %d queued "
+                "for sequence-deduplicated resend, %d unrecoverable "
+                "(updates_lost=%d drains as resends are acked)",
+                len(self._unacked), len(resendable),
+                len(self._unacked) - len(resendable), self.updates_lost,
+            )
+            self._unacked.clear()
         self._connect()
+
+    def _ensure_sock(self) -> None:
+        """Reopen the connection when a previous failed reconnect left
+        it closed (the outer supervised retry re-enters ops here)."""
+        if self._sock is None:
+            self._connect()
+
+    def _seq_head(self, seq: int) -> bytes:
+        cid = self.client_id.encode("utf-8")
+        return b"S" + _U16.pack(len(cid)) + cid + _U64.pack(seq)
+
+    def _flush_resends(self) -> None:
+        """Replay queued unacked pushes (synchronously — ack per frame;
+        the queue is short and this path is the recovery path, not the
+        hot path). Each ack, applied or duplicate-skipped, drains one
+        unit of ``updates_lost``."""
+        while self._resend:
+            seq, body = self._resend[0]
+            self._sock.sendall(self._seq_head(seq) + body)
+            ack = sockets.read_exact(self._sock, 1)
+            if ack not in (b"k", b"d"):
+                raise ConnectionError(f"bad resend ack {ack!r}")
+            self._resend.popleft()
+            self.updates_lost = max(0, self.updates_lost - 1)
+            self.updates_resent += 1
+            if ack == b"d":
+                self.updates_duplicate += 1
+            self.bytes_sent += len(body)
 
     def _drain_acks(self) -> None:
         """Collect outstanding update acks. Pushes are PIPELINED — the
@@ -308,10 +472,12 @@ class SocketClient(BaseParameterClient):
         round-trip per binary push would regress it; instead the ack is
         read before the next op on this connection (the server answers
         ops in order), keeping error detection without the stall."""
-        while self._pending_acks:
+        while self._unacked:
             ack = sockets.read_exact(self._sock, 1)
-            self._pending_acks -= 1
-            if ack != b"k":
+            seq, _body = self._unacked.popleft()
+            if ack == b"d":
+                self.updates_duplicate += 1
+            elif ack != b"k":
                 raise ConnectionError(f"bad update ack {ack!r}")
 
     def _close_sock(self) -> None:
@@ -349,7 +515,9 @@ class SocketClient(BaseParameterClient):
         return self._retry(self._get_once)
 
     def _get_once(self):
+        self._ensure_sock()
         if self._binary:
+            self._flush_resends()
             self._drain_acks()
             comp = b"\x01" if self.pull_compression == "int8" else b"\x00"
             self._sock.sendall(b"G" + comp)
@@ -363,33 +531,117 @@ class SocketClient(BaseParameterClient):
         return out
 
     def update_parameters(self, delta) -> None:
-        """Push one delta. Retries after a reconnect make this
-        at-least-once (a resend can double-apply if the server took the
-        first copy before the drop); a push whose connection dies
-        before its pipelined ack is counted in ``updates_lost``."""
+        """Push one delta. Against a version-2 server each push carries
+        a monotonic sequence ID, so retries/resends after a reconnect
+        are deduplicated server-side — effectively-once. Against a
+        version-1 server the old at-least-once caveat stands (a resend
+        can double-apply), and a push whose connection dies before its
+        pipelined ack is counted in ``updates_lost`` without resend."""
         if self._binary:
             body = self._encode_update(delta)  # once: feedback mutates
-            self._retry(lambda: self._push_once(body))
+            seq = self._next_seq() if self._sequenced else None
+            self._retry(lambda: self._push_once(seq, body))
         else:
             self._retry(lambda: self._push_pickle(delta))
 
-    def _push_once(self, body: bytes) -> None:
+    def _push_once(self, seq: int | None, body: bytes) -> None:
+        self._ensure_sock()
+        self._flush_resends()
         self._drain_acks()
-        self._sock.sendall(b"U" + body)
-        self._pending_acks += 1
+        if seq is not None:
+            self._sock.sendall(self._seq_head(seq) + body)
+            self._unacked.append((seq, body))
+        else:
+            self._sock.sendall(b"U" + body)
+            self._unacked.append((None, None))
         self.bytes_sent += len(body)
+        if seq is not None and self.chaos_duplicate is not None \
+                and self.chaos_duplicate(seq):
+            # chaos harness: duplicate the identical frame on the wire
+            # (kept resendable — replaying a duplicate is still a dedup)
+            self.chaos_dups_sent += 1
+            self._sock.sendall(self._seq_head(seq) + body)
+            self._unacked.append((seq, body))
 
     def _push_pickle(self, delta) -> None:
+        self._ensure_sock()
         self._sock.sendall(b"u")
         # legacy-pickle fallback path
         self.bytes_sent += sockets.send(self._sock, delta)
+
+    # -- liveness (ISSUE 3) -------------------------------------------
+
+    def flush(self) -> None:
+        """Confirm delivery of every push: replay queued resends and
+        drain every pipelined ack, reconnect-retrying on failure. The
+        worker calls this under its supervised retry before reporting a
+        partition done — without it, a connection that dies holding the
+        FINAL pushes of a run would lose them silently in close()."""
+        if not self._binary:
+            return
+
+        def once():
+            self._ensure_sock()
+            self._flush_resends()
+            self._drain_acks()
+
+        self._retry(once)
+
+    def heartbeat(self) -> None:
+        """Refresh this worker's lease over the existing connection.
+        No-op against pre-version-2 servers (no leases) and on
+        legacy-pinned connections (an unknown op closes those)."""
+        if not self._sequenced or not self._binary:
+            return
+
+        def once():
+            self._ensure_sock()
+            self._flush_resends()
+            self._drain_acks()
+            cid = self.client_id.encode("utf-8")
+            self._sock.sendall(b"H" + _U16.pack(len(cid)) + cid)
+            if sockets.read_exact(self._sock, 1) != b"k":
+                raise ConnectionError("bad heartbeat ack")
+
+        self._retry(once)
+
+    def status(self) -> dict:
+        """The server's status JSON (membership, counters, journal).
+        Raises against pre-version-2 servers."""
+        if not self._sequenced:
+            raise ConnectionError(
+                f"server protocol version {self._proto_version} has no "
+                f"status op (needs >= 2)"
+            )
+
+        def once():
+            self._ensure_sock()
+            self._flush_resends()
+            self._drain_acks()
+            self._sock.sendall(b"s")
+            (n,) = _U32.unpack(sockets.read_exact(self._sock, 4))
+            return json.loads(sockets.read_exact(self._sock, n))
+
+        return self._retry(once)
 
     def close(self) -> None:
         if self._sock is None:
             return
         try:
+            self._flush_resends()
             self._drain_acks()  # surface in-flight update failures
             self._sock.sendall(b"q")
-        except OSError:
-            pass
+        except OSError as e:
+            # a best-effort close must not raise, but pushes dying HERE
+            # are real losses — count and log them, never swallow
+            # silently (callers that need certainty call flush() first)
+            in_doubt = len(self._unacked) + len(self._resend)
+            if in_doubt:
+                self.updates_lost += len(self._unacked)
+                logger.warning(
+                    "close() with %d unconfirmed update(s) on a dead "
+                    "connection (%r) — call flush() before close() for "
+                    "confirmed delivery (updates_lost=%d)",
+                    in_doubt, e, self.updates_lost,
+                )
         self._close_sock()

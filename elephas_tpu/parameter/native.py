@@ -137,9 +137,20 @@ class _Flattener:
 
 
 class NativeParameterServer:
-    """Drop-in for ``HttpServer``/``SocketServer`` with a native core."""
+    """Drop-in for ``HttpServer``/``SocketServer`` with a native core.
 
-    def __init__(self, weights, mode: str = "asynchronous", port: int = 0):
+    ISSUE 3: ``journal_dir`` makes the store restartable — the weight
+    vector snapshots through the shared journal format on ``stop()``
+    and on :meth:`write_journal`, and a new server over the same
+    directory replays it. The native wire has NO sequence IDs (the C++
+    protocol carries raw f32 frames only), so resends can still
+    double-apply here; use the Python servers when effectively-once
+    matters. The journal's sequence table is therefore always empty.
+    """
+
+    def __init__(self, weights, mode: str = "asynchronous", port: int = 0,
+                 journal_dir: str | None = None,
+                 restore_journal: bool = True):
         self._lib = _load_library()
         self._flat = _Flattener(weights)
         use_lock = 0 if mode == "hogwild" else 1
@@ -149,7 +160,26 @@ class NativeParameterServer:
         if not self._handle:
             raise OSError(f"native parameter server failed to bind port {port}")
         self.port = self._lib.eps_server_port(self._handle)
+        self.journal_dir = journal_dir
+        self.restored_from_journal = False
+        if journal_dir and restore_journal:
+            from elephas_tpu.parameter import journal as journal_io
+
+            state = journal_io.load_journal(journal_dir)
+            if state is not None:
+                restored, _seq_table, _meta = state
+                weights = restored  # shapes re-checked by set_weights
+                self.restored_from_journal = True
         self.set_weights(weights)
+
+    def write_journal(self) -> str | None:
+        if not self.journal_dir:
+            return None
+        from elephas_tpu.parameter import journal as journal_io
+
+        return journal_io.save_journal(
+            self.journal_dir, self.get_parameters(), {}, meta={"mode": "native"}
+        )
 
     def start(self) -> None:  # the C++ accept loop starts at create
         pass
@@ -190,26 +220,53 @@ class NativeParameterServer:
 
     def stop(self) -> None:
         if self._handle:
+            self.write_journal()  # terminal snapshot: clean stops resume
             self._lib.eps_server_stop(self._handle)
             self._handle = None
 
     def __del__(self):
         try:
             self.stop()
-        except Exception:
+        except Exception:  # fault-lint: allow — interpreter-teardown destructor
             pass
 
 
 class NativeClient:
     """Binary-protocol client (usable against the C++ server from any
-    host; carries a ``_Flattener`` built from the model's weight spec)."""
+    host; carries a ``_Flattener`` built from the model's weight spec).
 
-    def __init__(self, host: str, port: int, flattener: _Flattener):
+    ISSUE 3 hardening: ops retry with capped backoff and reconnect on a
+    dead socket (``utils.sockets.retry_call``), so a native-PS restart
+    pauses the worker instead of killing it. The native wire has no
+    sequence IDs — a retried update that did land double-applies, the
+    pre-ISSUE-3 at-least-once caveat.
+    """
+
+    def __init__(self, host: str, port: int, flattener: _Flattener,
+                 retries: int = 3):
         from elephas_tpu.utils import sockets
 
         self._flat = flattener
+        self._host, self._port = host, port
+        self.retries = retries
         # hardened connect: deadline + NODELAY (utils.sockets)
         self._sock = sockets.connect(host, port)
+
+    def _reconnect(self, *_args) -> None:
+        from elephas_tpu.utils import sockets
+
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = sockets.connect(self._host, self._port)
+
+    def _retry(self, fn):
+        from elephas_tpu.utils import sockets
+
+        return sockets.retry_call(
+            fn, retries=self.retries, on_retry=self._reconnect
+        )
 
     def _recv_exact(self, n: int) -> bytes:
         buf = bytearray()
@@ -221,17 +278,24 @@ class NativeClient:
         return bytes(buf)
 
     def get_parameters(self):
-        self._sock.sendall(b"g")
-        (nbytes,) = struct.unpack("<Q", self._recv_exact(8))
-        flat = np.frombuffer(self._recv_exact(nbytes), dtype=np.float32)
-        return self._flat.unflatten(flat)
+        def once():
+            self._sock.sendall(b"g")
+            (nbytes,) = struct.unpack("<Q", self._recv_exact(8))
+            flat = np.frombuffer(self._recv_exact(nbytes), dtype=np.float32)
+            return self._flat.unflatten(flat)
+
+        return self._retry(once)
 
     def _send_buffer(self, op: bytes, weights) -> None:
         flat = np.ascontiguousarray(self._flat.flatten(weights))
-        self._sock.sendall(
-            op + struct.pack("<Q", flat.nbytes) + flat.tobytes()
-        )
-        assert self._recv_exact(1) == b"k"
+        payload = op + struct.pack("<Q", flat.nbytes) + flat.tobytes()
+
+        def once():
+            self._sock.sendall(payload)
+            if self._recv_exact(1) != b"k":
+                raise ConnectionError("bad native update ack")
+
+        self._retry(once)
 
     def update_parameters(self, delta) -> None:
         self._send_buffer(b"u", delta)

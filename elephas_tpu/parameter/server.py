@@ -14,32 +14,70 @@ stays bounded at one chunk. The pickled endpoints/op-codes remain as the
 negotiated legacy fallback; do not expose these ports to untrusted
 networks.
 
+ISSUE 3 (fault tolerance): both servers are **journaled and
+restartable** — ``journal_dir`` snapshots weights + the per-client
+sequence table atomically every ``journal_every`` applied updates (and
+on ``stop()``), and a server constructed over an existing journal
+replays it, so a crashed PS restarts where it left off. Updates carry
+**client-assigned monotonic sequence IDs** (op ``b'S'`` / the
+``X-Elephas-Seq`` header): an update whose ``(client, seq)`` was
+already applied is skipped, which makes the clients' at-least-once
+retries effectively-once. Workers **register and heartbeat** on their
+existing keep-alive connections (op ``b'H'`` / ``POST /heartbeat``);
+the ``b's'`` op / ``GET /status`` expose membership, staleness, and
+update/duplicate counters as JSON.
+
+Sequenced updates dedup-then-apply under the sequence lock even in
+hogwild mode — exactly-once beats the lock-free race for updates that
+ask for it; the legacy unsequenced ops keep hogwild's documented
+torn-apply behavior.
+
 Socket op-codes: ``b'?'`` capability probe (reply: protocol version
 byte), ``b'G'`` binary get (+1 request byte: 0 dense / 1 int8),
-``b'U'`` binary update (frames in, ``b'k'`` ack out), and the legacy
-``b'g'`` / ``b'u'`` / ``b'q'`` pickle trio.
+``b'U'`` binary update (frames in, ``b'k'`` ack out), ``b'S'``
+sequenced binary update (u16 id-length + client id + u64 seq + frames
+in; ``b'k'`` applied / ``b'd'`` duplicate-skipped out), ``b'H'``
+heartbeat (u16 id-length + client id; ``b'k'`` out), ``b's'`` status
+(u32 length + JSON out), and the legacy ``b'g'`` / ``b'u'`` / ``b'q'``
+pickle trio.
 
 HTTP: ``GET /parameters.bin[?comp=int8]`` streams codec frames with
 chunked transfer-encoding; ``POST /update.bin`` carries codec frames in
-the body; legacy ``/parameters`` / ``/update`` stay pickled. Responses
-are HTTP/1.1 so clients reuse one connection across sync rounds.
+the body (optional ``X-Elephas-Client`` + ``X-Elephas-Seq`` headers
+enable idempotent apply; the reply's ``X-Elephas-Applied`` is ``0`` for
+a duplicate); ``POST /heartbeat`` refreshes the client's lease;
+``GET /status`` returns the status JSON; legacy ``/parameters`` /
+``/update`` stay pickled. Responses are HTTP/1.1 so clients reuse one
+connection across sync rounds.
 """
 
 from __future__ import annotations
 
+import json
+import logging
 import pickle
 import socket
 import socketserver
+import struct
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from elephas_tpu.parameter import codec as wire
+from elephas_tpu.parameter import journal as journal_io
 from elephas_tpu.utils import sockets
 from elephas_tpu.utils.functional_utils import add_params
 
-PROTOCOL_VERSION = 1
+logger = logging.getLogger(__name__)
+
+# version 2: sequenced updates (S), heartbeats (H), status (s)
+PROTOCOL_VERSION = 2
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
 
 
 class BaseParameterServer:
@@ -47,10 +85,23 @@ class BaseParameterServer:
 
     ``mode='asynchronous'`` serializes updates under a lock;
     ``mode='hogwild'`` applies them lock-free (torn reads/writes are
-    accepted, as in the reference).
+    accepted, as in the reference). With ``journal_dir`` the server is
+    restartable: state snapshots to disk every ``journal_every``
+    applied updates and a new server over the same directory replays
+    the snapshot (weights AND the sequence table, so post-restart
+    resends still deduplicate).
     """
 
-    def __init__(self, weights, mode: str = "asynchronous", port: int = 4000):
+    def __init__(
+        self,
+        weights,
+        mode: str = "asynchronous",
+        port: int = 4000,
+        journal_dir: str | None = None,
+        journal_every: int = 50,
+        lease_timeout: float = 30.0,
+        restore_journal: bool = True,
+    ):
         self.mode = mode
         self.port = port
         self.lock = threading.Lock()
@@ -58,6 +109,65 @@ class BaseParameterServer:
         self._started = False
         self._dense_codec = wire.WireCodec()
         self._int8_codec = wire.WireCodec(compression="int8")
+
+        # -- fault-tolerance state (ISSUE 3) ---------------------------
+        self.journal_dir = journal_dir
+        self.journal_every = max(1, int(journal_every))
+        self.lease_timeout = float(lease_timeout)
+        self.seq_table: dict[str, int] = {}  # client id -> last applied seq
+        self.leases: dict[str, float] = {}  # client id -> last heartbeat
+        self.updates_applied = 0
+        self.updates_duplicate = 0
+        self.journal_writes = 0
+        self.restored_from_journal = False
+        self._seq_lock = threading.Lock()
+        self._journal_lock = threading.Lock()
+        self._last_journal_at = 0  # updates_applied at the last snapshot
+        self._created_at = time.monotonic()
+        # live client connections: stdlib shutdown() only stops the
+        # ACCEPT loop — established keep-alive connections would keep
+        # being served by zombie handler threads after stop(), so a
+        # "stopped" server would silently keep applying updates. Track
+        # them so stop() severs them too.
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        # stop() raises this FIRST: handlers refuse further ops, so no
+        # zombie service rides the gap until the accept loop notices
+        # (its poll interval is whole tenths of a second — long enough
+        # for a fast client to slip many ops through otherwise)
+        self._closing = False
+        self._lease_lock = threading.Lock()
+        # restore_journal=False serves a journaled store WITHOUT
+        # replaying an existing journal (a fresh, non-resumed fit must
+        # not silently continue from a previous run's state); the
+        # journal is then overwritten as this run snapshots
+        if journal_dir and restore_journal:
+            self._restore_journal(journal_dir)
+
+    def _restore_journal(self, journal_dir: str) -> None:
+        state = journal_io.load_journal(journal_dir)
+        if state is None:
+            return
+        restored, seq_table, meta = state
+        if len(restored) != len(self.weights) or any(
+            r.shape != w.shape or r.dtype != w.dtype
+            for r, w in zip(restored, self.weights)
+        ):
+            raise ValueError(
+                f"journal under {journal_dir} holds "
+                f"{[(w.dtype.name, w.shape) for w in restored]} but the "
+                f"server was constructed with "
+                f"{[(w.dtype.name, w.shape) for w in self.weights]} — "
+                f"refusing to mix states from different models"
+            )
+        self.weights = restored
+        self.seq_table = seq_table
+        self.restored_from_journal = True
+        logger.info(
+            "parameter server restored from journal %s (%d client "
+            "sequence entries, snapshot meta %s)",
+            journal_dir, len(seq_table), meta,
+        )
 
     # -- weight store --------------------------------------------------
 
@@ -74,6 +184,27 @@ class BaseParameterServer:
         else:  # hogwild: deliberately lock-free
             self.weights = add_params(self.weights, delta)
 
+    def apply_update(
+        self, delta, client_id: str | None = None, seq: int | None = None
+    ) -> bool:
+        """Apply one delta, idempotently when ``(client_id, seq)`` is
+        given: a sequence ID at or below the client's last applied one
+        is skipped (the at-least-once wire resend case). Returns True
+        iff the delta was applied."""
+        if client_id is None or seq is None:
+            self.update_parameters(delta)
+            self._note_update()
+            return True
+        with self._seq_lock:
+            if seq <= self.seq_table.get(client_id, -1):
+                self.updates_duplicate += 1
+                return False
+            self.update_parameters(delta)
+            self.seq_table[client_id] = int(seq)
+        self.heartbeat(client_id)
+        self._note_update()
+        return True
+
     def set_weights(self, weights) -> None:
         with self.lock:
             self.weights = [np.asarray(w) for w in weights]
@@ -82,6 +213,118 @@ class BaseParameterServer:
         """Current weights as codec frames (the binary get path)."""
         enc = self._int8_codec if compression == "int8" else self._dense_codec
         return enc.encode_frames(self.get_parameters())
+
+    # -- liveness / membership (ISSUE 3) -------------------------------
+
+    def heartbeat(self, client_id: str) -> None:
+        """Refresh ``client_id``'s lease (registration is implicit:
+        the first heartbeat or sequenced update creates it)."""
+        with self._lease_lock:
+            self.leases[client_id] = time.monotonic()
+
+    def members(self) -> dict[str, dict]:
+        """Known workers with lease staleness: ``{id: {age_s, live}}``.
+        A worker is live while its last heartbeat is within
+        ``lease_timeout`` seconds."""
+        with self._lease_lock:
+            # copy: handler threads register members concurrently
+            leases = list(self.leases.items())
+        now = time.monotonic()
+        return {
+            cid: {
+                "age_s": round(now - t, 3),
+                "live": (now - t) <= self.lease_timeout,
+            }
+            for cid, t in sorted(leases)
+        }
+
+    def status(self) -> dict:
+        """The ``status`` op payload: mode, membership, update and
+        journal counters — everything a supervisor needs to decide
+        whether training is healthy."""
+        with self._seq_lock:
+            seq_table = dict(self.seq_table)
+        return {
+            "protocol_version": PROTOCOL_VERSION,
+            "mode": self.mode,
+            "uptime_s": round(time.monotonic() - self._created_at, 3),
+            "updates_applied": self.updates_applied,
+            "updates_duplicate": self.updates_duplicate,
+            "members": self.members(),
+            "seq_table": seq_table,
+            "journal": {
+                "dir": self.journal_dir,
+                "every": self.journal_every,
+                "writes": self.journal_writes,
+                "restored": self.restored_from_journal,
+            },
+        }
+
+    # -- connection tracking (ISSUE 3) ---------------------------------
+
+    def _track(self, sock) -> bool:
+        """Register a live connection; returns False (connection
+        refused) when the server is already stopping."""
+        with self._conns_lock:
+            if self._closing:
+                return False
+            self._conns.add(sock)
+        return True
+
+    def _untrack(self, sock) -> None:
+        with self._conns_lock:
+            self._conns.discard(sock)
+
+    def _close_connections(self) -> None:
+        """Sever every live client connection — part of stop(): a
+        stopped (or chaos-killed) server must stop SERVING, not just
+        stop accepting."""
+        with self._conns_lock:
+            self._closing = True
+            conns, self._conns = list(self._conns), set()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- journaling (ISSUE 3) ------------------------------------------
+
+    def _note_update(self) -> None:
+        with self._seq_lock:  # concurrent clients: no lost increments
+            self.updates_applied += 1
+            due = bool(self.journal_dir) and (
+                self.updates_applied - self._last_journal_at
+                >= self.journal_every
+            )
+        if due:  # outside _seq_lock: write_journal re-acquires it
+            self.write_journal()
+
+    def write_journal(self) -> str | None:
+        """Snapshot weights + sequence table now (atomic replace).
+        No-op without ``journal_dir``."""
+        if not self.journal_dir:
+            return None
+        with self._journal_lock:
+            with self._seq_lock:
+                seq_table = dict(self.seq_table)
+                weights = self.get_parameters()
+            path = journal_io.save_journal(
+                self.journal_dir,
+                weights,
+                seq_table,
+                meta={
+                    "mode": self.mode,
+                    "updates_applied": self.updates_applied,
+                },
+            )
+            self.journal_writes += 1
+            self._last_journal_at = self.updates_applied
+            return path
 
     # -- lifecycle -----------------------------------------------------
 
@@ -95,8 +338,9 @@ class BaseParameterServer:
 class HttpServer(BaseParameterServer):
     """``GET /parameters[.bin]`` / ``POST /update[.bin]`` over stdlib HTTP."""
 
-    def __init__(self, weights, mode: str = "asynchronous", port: int = 4000):
-        super().__init__(weights, mode, port)
+    def __init__(self, weights, mode: str = "asynchronous", port: int = 4000,
+                 **ft_kwargs):
+        super().__init__(weights, mode, port, **ft_kwargs)
         self._httpd = None
         self._thread = None
 
@@ -106,6 +350,16 @@ class HttpServer(BaseParameterServer):
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"  # connection reuse across syncs
             disable_nagle_algorithm = True
+
+            def setup(self):
+                super().setup()
+                if not server._track(self.connection):
+                    self.close_connection = True
+                    raise ConnectionAbortedError("server stopping")
+
+            def finish(self):
+                server._untrack(self.connection)
+                super().finish()
 
             def log_message(self, *args):  # silence request logging
                 pass
@@ -135,6 +389,14 @@ class HttpServer(BaseParameterServer):
 
                     sockets.send_frames(self.connection, te_pieces())
                     return
+                if path == "/status":
+                    payload = json.dumps(server.status()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 if path != "/parameters":
                     self.send_error(404)
                     return
@@ -156,14 +418,32 @@ class HttpServer(BaseParameterServer):
                 return b"".join(chunks)
 
             def do_POST(self):
+                if self.path == "/heartbeat":
+                    cid = self.headers.get("X-Elephas-Client")
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length:
+                        self._read_exact(length)  # drain any body
+                    if cid:
+                        server.heartbeat(cid)
+                    self.send_response(200 if cid else 400)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 if self.path == "/update.bin":
                     # frames are self-delimiting; decode straight off the
                     # body so only one chunk is transient at a time
                     delta = wire.decode_stream(
                         self._read_exact, self.rfile.readinto
                     )
-                    server.update_parameters(delta)
+                    cid = self.headers.get("X-Elephas-Client")
+                    seq = self.headers.get("X-Elephas-Seq")
+                    applied = server.apply_update(
+                        delta, cid, int(seq) if seq is not None else None
+                    )
                     self.send_response(200)
+                    self.send_header(
+                        "X-Elephas-Applied", "1" if applied else "0"
+                    )
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
@@ -173,12 +453,21 @@ class HttpServer(BaseParameterServer):
                 length = int(self.headers.get("Content-Length", 0))
                 # legacy-pickle fallback endpoint
                 delta = pickle.loads(self._read_exact(length))
-                server.update_parameters(delta)
+                server.apply_update(delta)
                 self.send_response(200)
                 self.send_header("Content-Length", "0")
                 self.end_headers()
 
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        class Httpd(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                if _is_connection_error():
+                    logger.debug(
+                        "http connection %s dropped", client_address
+                    )
+                    return
+                super().handle_error(request, client_address)
+
+        self._httpd = Httpd(("0.0.0.0", self.port), Handler)
         self.port = self._httpd.server_address[1]  # resolves port=0
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
@@ -186,9 +475,18 @@ class HttpServer(BaseParameterServer):
         self._thread.start()
         self._started = True
 
-    def stop(self) -> None:
+    def stop(self, flush_journal: bool = True) -> None:
         if self._httpd is not None:
+            # sever FIRST: the accept loop's poll interval is long
+            # enough for a fast client to slip ops through a still-
+            # serving handler after "stop" otherwise
+            self._close_connections()
             self._httpd.shutdown()
+            if flush_journal:
+                # terminal snapshot: clean stops resume exactly; the
+                # chaos harness passes False to simulate a CRASH (the
+                # restart then replays the last periodic snapshot)
+                self.write_journal()
             self._httpd.server_close()
             self._httpd = None
             self._started = False
@@ -197,8 +495,9 @@ class HttpServer(BaseParameterServer):
 class SocketServer(BaseParameterServer):
     """Raw-TCP op-code protocol (binary codec fast path + pickle legacy)."""
 
-    def __init__(self, weights, mode: str = "asynchronous", port: int = 4000):
-        super().__init__(weights, mode, port)
+    def __init__(self, weights, mode: str = "asynchronous", port: int = 4000,
+                 **ft_kwargs):
+        super().__init__(weights, mode, port, **ft_kwargs)
         self._server = None
         self._thread = None
 
@@ -208,12 +507,20 @@ class SocketServer(BaseParameterServer):
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
+                if not ps._track(sock):
+                    return  # stopping: refuse the zombie connection
+                try:
+                    self._serve(sock)
+                finally:
+                    ps._untrack(sock)
+
+            def _serve(self, sock):
                 sock.setsockopt(
                     socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
                 )
                 while True:
                     op = sock.recv(1)
-                    if not op or op == b"q":
+                    if not op or op == b"q" or ps._closing:
                         return
                     if op == b"?":
                         sock.sendall(bytes([PROTOCOL_VERSION]))
@@ -227,19 +534,47 @@ class SocketServer(BaseParameterServer):
                         delta = wire.decode_stream(
                             sockets.reader(sock), sockets.reader_into(sock)
                         )
-                        ps.update_parameters(delta)
+                        ps.apply_update(delta)
                         sock.sendall(b"k")
+                    elif op == b"S":
+                        # sequenced update: id + seq header, then frames;
+                        # the frames are always consumed (self-delimiting
+                        # stream), the dedup decision follows
+                        cid = _read_client_id(sock)
+                        (seq,) = _U64.unpack(sockets.read_exact(sock, 8))
+                        delta = wire.decode_stream(
+                            sockets.reader(sock), sockets.reader_into(sock)
+                        )
+                        applied = ps.apply_update(delta, cid, seq)
+                        sock.sendall(b"k" if applied else b"d")
+                    elif op == b"H":
+                        ps.heartbeat(_read_client_id(sock))
+                        sock.sendall(b"k")
+                    elif op == b"s":
+                        payload = json.dumps(ps.status()).encode()
+                        sock.sendall(_U32.pack(len(payload)) + payload)
                     elif op == b"g":  # legacy-pickle fallback
                         sockets.send(sock, ps.get_parameters())
                     elif op == b"u":  # legacy-pickle fallback
                         delta = sockets.receive(sock)
-                        ps.update_parameters(delta)
+                        ps.apply_update(delta)
                     else:
                         return
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                # dropped/severed connections are expected under chaos
+                # and during stop(); anything else still gets the
+                # stdlib traceback
+                if _is_connection_error():
+                    logger.debug(
+                        "socket connection %s dropped", client_address
+                    )
+                    return
+                super().handle_error(request, client_address)
 
         self._server = Server(("0.0.0.0", self.port), Handler)
         self.port = self._server.server_address[1]
@@ -249,9 +584,27 @@ class SocketServer(BaseParameterServer):
         self._thread.start()
         self._started = True
 
-    def stop(self) -> None:
+    def stop(self, flush_journal: bool = True) -> None:
         if self._server is not None:
+            # sever FIRST — see HttpServer.stop
+            self._close_connections()
             self._server.shutdown()
+            if flush_journal:
+                # terminal snapshot: clean stops resume exactly; the
+                # chaos harness passes False to simulate a CRASH (the
+                # restart then replays the last periodic snapshot)
+                self.write_journal()
             self._server.server_close()
             self._server = None
             self._started = False
+
+
+def _read_client_id(sock) -> str:
+    (idlen,) = _U16.unpack(sockets.read_exact(sock, 2))
+    return sockets.read_exact(sock, idlen).decode("utf-8")
+
+
+def _is_connection_error() -> bool:
+    import sys
+
+    return isinstance(sys.exc_info()[1], (ConnectionError, OSError))

@@ -320,11 +320,14 @@ class InferenceEngine:
     # -- request API ---------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int,
-               temperature: float = 0.0, eos_id: int | None = None
-               ) -> Request:
+               temperature: float = 0.0, eos_id: int | None = None,
+               on_token=None) -> Request:
         """Queue one generation request (admitted at the next step —
         submission is legal at any time, including mid-flight). Every
-        gang process must submit the identical sequence of requests."""
+        gang process must submit the identical sequence of requests.
+        ``on_token(token, done)`` streams tokens to the caller as they
+        land; a raising callback fails only ITS request (``req.error``
+        set, KV slot reclaimed) — the engine keeps serving."""
         prompt = np.asarray(prompt).reshape(-1)
         p = len(prompt)
         if p < 1:
@@ -343,7 +346,8 @@ class InferenceEngine:
         # ladder may top out below the model's maxlen
         self.scheduler.bucket_for(p)
         req = self.scheduler.make_request(
-            prompt, max_new_tokens, temperature=temperature, eos_id=eos_id
+            prompt, max_new_tokens, temperature=temperature, eos_id=eos_id,
+            on_token=on_token,
         )
         req.submit_time = time.perf_counter()
         self.scheduler.submit(req)
@@ -351,10 +355,26 @@ class InferenceEngine:
 
     def _emit(self, req: Request, token: int) -> bool:
         """Record one generated token; reclaim + file the request when
-        it finished. Returns done."""
+        it finished. Returns done.
+
+        A raising per-token callback fails the request CLEANLY: before
+        this guard, the exception unwound through step() after the
+        scheduler had recorded the token but before reclaim, leaking
+        the KV slot for the engine's lifetime."""
         self.total_generated += 1
         slot = req.slot
         done = self.scheduler.on_token(slot, token)
+        if req.on_token is not None:
+            try:
+                req.on_token(token, done)
+            except Exception as e:
+                req.error = e
+                req.done = True
+                done = True
+                logger.warning(
+                    "request %d failed in its on_token callback (%r) — "
+                    "slot %d reclaimed, engine continues", req.rid, e, slot,
+                )
         if done:
             req.finish_time = time.perf_counter()
             self.scheduler.reclaim(slot)

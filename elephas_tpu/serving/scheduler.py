@@ -61,7 +61,10 @@ class Request:
 
     ``tokens`` accumulates the GENERATED continuation only (the prompt
     is not repeated there); ``emitted`` marks how many of those the
-    caller has already consumed via the streaming iterator."""
+    caller has already consumed via the streaming iterator.
+    ``on_token(token, done)`` is an optional per-token consumer
+    callback; when it raises, the engine fails THIS request (``error``
+    set, slot reclaimed) and keeps serving the rest."""
 
     rid: int
     prompt: tuple
@@ -74,6 +77,8 @@ class Request:
     emitted: int = 0
     submit_time: float | None = None
     finish_time: float | None = None
+    on_token: object | None = None
+    error: BaseException | None = None
 
     @property
     def full_sequence(self) -> list:
@@ -102,13 +107,14 @@ class Scheduler:
         return request
 
     def make_request(self, prompt, max_new_tokens, temperature=0.0,
-                     eos_id=None) -> Request:
+                     eos_id=None, on_token=None) -> Request:
         return Request(
             rid=next(self._ids),
             prompt=tuple(int(t) for t in prompt),
             max_new_tokens=int(max_new_tokens),
             temperature=float(temperature),
             eos_id=None if eos_id is None else int(eos_id),
+            on_token=on_token,
         )
 
     # -- per-step decisions --------------------------------------------

@@ -94,6 +94,8 @@ class SparkModel:
         batch_size: int = 32,
         port: int = 4000,
         ps_overlap: bool | None = None,
+        ps_journal_dir: str | None = None,
+        failure_budget: int = 0,
         model_parallel: int = 1,
         pipeline_parallel: int = 1,
         pipeline_microbatches: int = 4,
@@ -140,6 +142,12 @@ class SparkModel:
         self.ps_overlap = (
             mode != "synchronous" if ps_overlap is None else bool(ps_overlap)
         )
+        # fault tolerance (ISSUE 3): journal the external weight store
+        # (crash-restartable PS; also the sub-epoch resume source for
+        # fit(resume=True)), and tolerate up to `failure_budget` lost
+        # worker partitions before aborting a fit
+        self.ps_journal_dir = ps_journal_dir
+        self.failure_budget = max(0, int(failure_budget))
         self._publisher = None
         self.model_parallel = int(model_parallel)
         self.pipeline_parallel = int(pipeline_parallel)
@@ -309,6 +317,8 @@ class SparkModel:
             "batch_size": self.batch_size,
             "port": self.port,
             "ps_overlap": self.ps_overlap,
+            "ps_journal_dir": self.ps_journal_dir,
+            "failure_budget": self.failure_budget,
             "model_parallel": self.model_parallel,
             "pipeline_parallel": self.pipeline_parallel,
             "pipeline_microbatches": self.pipeline_microbatches,
@@ -318,7 +328,7 @@ class SparkModel:
 
     # -- parameter server (API parity; see module docstring) -----------
 
-    def start_server(self) -> None:
+    def start_server(self, restore_journal: bool = True) -> None:
         if self.parameter_server_mode is None:
             return
         from elephas_tpu.parallel.distributed import is_coordinator
@@ -338,8 +348,19 @@ class SparkModel:
             from elephas_tpu.parameter.native import NativeParameterServer
 
             cls = NativeParameterServer
+        kwargs = {}
+        if self.ps_journal_dir:
+            # journaled store (ISSUE 3): restartable, and the sub-epoch
+            # state source for fit(resume=True) — the constructor
+            # replays an existing journal before serving. A fresh
+            # (non-resume) fit passes restore_journal=False: starting
+            # over must not silently continue from a previous run's
+            # journal (it gets overwritten as this run snapshots).
+            kwargs["journal_dir"] = self.ps_journal_dir
+            kwargs["restore_journal"] = restore_journal
         self._parameter_server = cls(
-            self._master_network.get_weights(), mode=self.mode, port=self.port
+            self._master_network.get_weights(), mode=self.mode,
+            port=self.port, **kwargs,
         )
         self._parameter_server.start()
         if self.ps_overlap and self.mode != "synchronous":
@@ -402,7 +423,11 @@ class SparkModel:
           epochs (view in TensorBoard/Perfetto).
         - ``checkpoint_dir``/``checkpoint_every``: snapshot model+optimizer
           every N epochs; ``resume=True`` restarts from the latest
-          snapshot, training only the remaining epochs.
+          snapshot, training only the remaining epochs. With
+          ``parameter_server_mode`` and ``ps_journal_dir`` set, resume
+          also replays the PS journal — sub-epoch state newer than the
+          checkpoint — and seeds both the server and the master model
+          from it (ISSUE 3).
         - out-of-core streaming: array-like inputs bigger than
           ``STREAM_THRESHOLD_BYTES`` (or lazily backed, or with
           ``stream_block_steps`` set) stream block-by-block through the
@@ -600,11 +625,34 @@ class SparkModel:
                 logger.info(
                     "resuming from %s at epoch %d", checkpoint_dir, start_epoch
                 )
+        if resume and self.ps_journal_dir:
+            # fit(resume=True) end-to-end (ISSUE 3): the PS journal may
+            # carry sub-epoch updates newer than the epoch-granular
+            # checkpoint restored above — adopt the journaled weights as
+            # the master state, and start_server below re-seeds the PS
+            # from the same journal, so neither the workers nor external
+            # pollers regress past the last snapshot
+            from elephas_tpu.parameter import journal as ps_journal
+
+            state = ps_journal.load_journal(self.ps_journal_dir)
+            if state is not None:
+                journaled, _seq_table, _meta = state
+                self._master_network.set_weights(journaled)
+                logger.info(
+                    "resume: adopted journaled parameter-server state "
+                    "from %s", self.ps_journal_dir,
+                )
         if start_epoch >= epochs:
             history = {"loss": []}
             self.training_histories.append(history)
             return history
         epochs = epochs - start_epoch
+
+        if partitions is not None:
+            # ISSUE 3: drop worker partitions whose executors died (the
+            # chaos harness injects these) and continue on the
+            # survivors, up to the configured failure budget
+            partitions = self._survive_partitions(partitions)
 
         if validation_split and validation_split > 0.0:
             # hold out the global tail fraction (keras semantics) by
@@ -632,7 +680,7 @@ class SparkModel:
         if partitions is not None:
             partitions = runner._fit_partitions_to_mesh(partitions)
 
-        self.start_server()
+        self.start_server(restore_journal=bool(resume))
         try:
             callbacks = []
             if self._parameter_server is not None:
@@ -728,6 +776,47 @@ class SparkModel:
             self.stop_server()
         self.training_histories.append(history)
         return history
+
+    def _survive_partitions(self, partitions):
+        """Worker-loss supervision (ISSUE 3): a partition whose executor
+        died (``fault.check_partition`` raises under an active chaos
+        plan) is dropped and training continues on the survivors — the
+        elastic-training degrade — until more than ``failure_budget``
+        workers are gone, which aborts with a clear error instead of
+        silently training on a sliver of the data."""
+        from elephas_tpu.fault.plan import (
+            FaultBudgetExceeded,
+            WorkerFault,
+            active_plan,
+            check_partition,
+        )
+
+        if active_plan() is None:
+            return partitions
+        survivors, lost = [], []
+        for i, part in enumerate(partitions):
+            try:
+                check_partition(i)
+            except WorkerFault as e:
+                logger.warning("worker partition %d lost: %s", i, e)
+                lost.append(i)
+                continue
+            survivors.append(part)
+        if not lost:
+            return partitions
+        if len(lost) > self.failure_budget or not survivors:
+            raise FaultBudgetExceeded(
+                f"lost {len(lost)} worker partition(s) {lost} of "
+                f"{len(partitions)}, exceeding failure_budget="
+                f"{self.failure_budget} (survivors: {len(survivors)}) — "
+                f"raise the budget to continue degraded, or repair the "
+                f"failing workers"
+            )
+        logger.warning(
+            "continuing with %d/%d worker partitions (failure_budget=%d)",
+            len(survivors), len(partitions), self.failure_budget,
+        )
+        return survivors
 
     def _make_val_evaluate(self, runner, val_partitions, val_spec,
                            val_block, batch_size):
@@ -1086,6 +1175,8 @@ def load_spark_model(file_name: str) -> SparkModel:
         batch_size=config.get("batch_size", 32),
         port=config.get("port", 4000),
         ps_overlap=config.get("ps_overlap"),
+        ps_journal_dir=config.get("ps_journal_dir"),
+        failure_budget=config.get("failure_budget", 0),
         model_parallel=config.get("model_parallel", 1),
         pipeline_parallel=config.get("pipeline_parallel", 1),
         pipeline_microbatches=config.get("pipeline_microbatches", 4),

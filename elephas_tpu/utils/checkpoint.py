@@ -24,9 +24,37 @@ from __future__ import annotations
 import json
 import os
 import re
+import tempfile
 
 _CKPT_RE = re.compile(r"ckpt-(\d+)\.keras$")
 _SHARDED_RE = re.compile(r"ckpt-(\d+)\.orbax$")
+
+
+def atomic_write(path: str, data: bytes) -> str:
+    """Crash-safe byte write: temp file in the target directory, fsync,
+    ``os.replace``. A process killed mid-write never leaves a torn file
+    at ``path`` — readers see either the old content or the new, whole.
+    The parameter-server journal (ISSUE 3) and the checkpoint sidecars
+    both write through here."""
+    path = os.path.abspath(path)
+    directory = os.path.dirname(path)
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=".tmp-" + os.path.basename(path) + "-"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
 
 
 # -- sharded (orbax, per-shard) format ----------------------------------
@@ -60,8 +88,10 @@ def save_sharded_checkpoint(
 
     if jax.process_index() == 0:
         meta_path = os.path.join(directory, f"ckpt-{epoch:05d}.meta.json")
-        with open(meta_path, "w") as f:
-            json.dump(meta or {"epoch": epoch, "history": {}}, f)
+        atomic_write(
+            meta_path,
+            json.dumps(meta or {"epoch": epoch, "history": {}}).encode(),
+        )
     return path
 
 
@@ -115,8 +145,10 @@ def save_checkpoint(model, directory: str, epoch: int, history: dict | None = No
     os.makedirs(directory, exist_ok=True)
     path = checkpoint_path(directory, epoch)
     model.save(path)
-    with open(path.replace(".keras", ".json"), "w") as f:
-        json.dump({"epoch": epoch, "history": history or {}}, f)
+    atomic_write(
+        path.replace(".keras", ".json"),
+        json.dumps({"epoch": epoch, "history": history or {}}).encode(),
+    )
     return path
 
 

@@ -29,6 +29,28 @@ _LEN = struct.Struct(">Q")
 CONNECT_TIMEOUT = 10.0
 IO_TIMEOUT = 120.0
 
+# chaos-injection hook (ISSUE 3, :mod:`elephas_tpu.fault`): when set,
+# called as ``hook(op)`` with ``op in ('connect', 'send', 'recv')`` at
+# the head of every socket primitive below. The hook may sleep (delay
+# injection), raise ``ConnectionError`` (drop/sever injection), or
+# no-op. Production code never sets it; the fault harness installs a
+# deterministic, seeded plan through :func:`set_fault_hook`.
+_FAULT_HOOK = None
+
+
+def set_fault_hook(hook):
+    """Install (or clear, with None) the chaos hook; returns the
+    previous hook so harnesses can restore it."""
+    global _FAULT_HOOK
+    previous = _FAULT_HOOK
+    _FAULT_HOOK = hook
+    return previous
+
+
+def _fault(op: str) -> None:
+    if _FAULT_HOOK is not None:
+        _FAULT_HOOK(op)
+
 
 def determine_master(port: int = 4000) -> str:
     """Resolve the coordinator host:port.
@@ -59,6 +81,7 @@ def connect(
 ) -> socket.socket:
     """TCP connection with a connect deadline, a read/write deadline,
     and Nagle off (sync round-trips are latency-bound)."""
+    _fault("connect")
     sock = socket.create_connection((host, port), timeout=connect_timeout)
     sock.settimeout(io_timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -100,6 +123,7 @@ def send_frames(sock: socket.socket, frames, coalesce: int = 1 << 18) -> int:
     memoryview payloads straight through — zero copies for the bulk
     bytes. Returns total bytes written; peak buffering stays ~one
     coalesce window."""
+    _fault("send")
     buf: list[bytes] = []
     size = total = 0
     for piece in frames:
@@ -127,6 +151,7 @@ def send_frames(sock: socket.socket, frames, coalesce: int = 1 << 18) -> int:
 def send(sock: socket.socket, obj) -> int:
     """Send one length-prefixed pickled frame (legacy-pickle fallback).
     Returns the payload byte count (callers keep wire accounting)."""
+    _fault("send")
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(_LEN.pack(len(payload)) + payload)
     return len(payload)
@@ -174,6 +199,7 @@ def reader_into(sock: socket.socket):
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    _fault("recv")
     if n == 0:
         return b""
     chunks, got = [], 0

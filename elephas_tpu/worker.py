@@ -50,6 +50,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from elephas_tpu.parallel.mesh import shard_map_compat
+from elephas_tpu.utils import sockets
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
@@ -1074,6 +1075,24 @@ class AsynchronousSparkWorker(SparkWorker):
     under the next period's compute, trading a bounded ``staleness``
     (in sync periods) for throughput — the async/hogwild trade, never
     applied to the synchronous worker.
+
+    ISSUE 3 (fault tolerance): each sync period runs under a
+    **supervised retry** — when a period's pull/push fails even after
+    the client's own reconnect retries (a PS crash/restart, a severed
+    wire), the worker backs off with capped exponential delays
+    (``utils.sockets.retry_call``), re-pulls fresh weights, and re-runs
+    that period, up to ``ps_retries`` times before giving up; a
+    transient PS outage therefore pauses training instead of killing
+    it. The worker registers under ``client_id`` and heartbeats the
+    server once per sync period on the existing connection, so the
+    server's ``status`` op reports live membership. On protocol-2
+    servers every push carries a sequence ID, making the period
+    re-run's resends effectively-once (a re-run period's *recompute*
+    trains that period's rows again — the documented at-least-once
+    training semantic of crash recovery). With lossy compression a
+    re-encoded retry folds the previous attempt's residual into the
+    fresh delta — DGC's delayed-error contract, preserved across
+    failures.
     """
 
     def __init__(
@@ -1094,6 +1113,9 @@ class AsynchronousSparkWorker(SparkWorker):
         pull_compression: str | None = None,
         overlap: bool = False,
         staleness: int = 1,
+        ps_retries: int = 6,
+        ps_retry_max_delay: float = 5.0,
+        client_id: str | None = None,
     ):
         super().__init__(
             json_model,
@@ -1115,6 +1137,9 @@ class AsynchronousSparkWorker(SparkWorker):
         self.pull_compression = pull_compression
         self.overlap = bool(overlap)
         self.staleness = max(1, int(staleness))
+        self.ps_retries = max(0, int(ps_retries))
+        self.ps_retry_max_delay = float(ps_retry_max_delay)
+        self.client_id = client_id
 
     def _client(self, model=None):
         from elephas_tpu.parameter.client import HttpClient, SocketClient
@@ -1143,10 +1168,15 @@ class AsynchronousSparkWorker(SparkWorker):
                 f"parameter_server_mode must be 'http', 'socket' or "
                 f"'native', got {self.parameter_server_mode!r}"
             )
+        # overlap rounds ride a background thread where the supervised
+        # period re-run below cannot reach them — give the client itself
+        # the longer retry horizon there
+        retries = max(3, self.ps_retries) if self.overlap else 3
         return cls(
             self.master, self.port,
             compression=self.compression, topk=self.topk,
             pull_compression=self.pull_compression,
+            retries=retries, client_id=self.client_id,
         )
 
     def _periods(self, x, y, epochs: int, batch_size: int):
@@ -1163,6 +1193,29 @@ class AsynchronousSparkWorker(SparkWorker):
             model.fit(xp, yp, epochs=1, batch_size=batch_size, verbose=0)
         else:
             model.train_on_batch(xp, yp)
+
+    def _heartbeat(self, client) -> None:
+        """Best-effort lease refresh once per sync period (liveness is
+        advisory; the period's own ops carry the hard failure path)."""
+        beat = getattr(client, "heartbeat", None)
+        if beat is None:
+            return
+        try:
+            beat()
+        except (ConnectionError, TimeoutError, OSError) as e:
+            logger.debug("heartbeat failed (non-fatal): %r", e)
+
+    def _supervised(self, fn):
+        """One sync period under the ISSUE 3 supervision contract:
+        capped-backoff re-runs survive a PS outage that outlasts the
+        client's own reconnect retries; the final failure propagates
+        so the driver's failure budget can count this worker."""
+        return sockets.retry_call(
+            fn,
+            retries=self.ps_retries,
+            base_delay=0.25,
+            max_delay=self.ps_retry_max_delay,
+        )
 
     def train(self, data_iterator):
         from elephas_tpu.utils.functional_utils import subtract_params
@@ -1181,14 +1234,30 @@ class AsynchronousSparkWorker(SparkWorker):
                 )
             else:
                 for xp, yp in self._periods(x, y, epochs, batch_size):
-                    before = client.get_parameters()
-                    model.set_weights(before)
-                    self._fit_period(model, xp, yp, batch_size)
-                    # server applies weights += delta, so the delta must
-                    # be the descent step (after − before)
-                    client.update_parameters(
-                        subtract_params(model.get_weights(), before)
-                    )
+
+                    def sync_period(xp=xp, yp=yp):
+                        # resume-from-last-PS-pull: every (re-)run of a
+                        # period starts from fresh server weights, so a
+                        # re-run after an outage trains on the
+                        # post-recovery state, not a stale snapshot
+                        self._heartbeat(client)
+                        before = client.get_parameters()
+                        model.set_weights(before)
+                        self._fit_period(model, xp, yp, batch_size)
+                        # server applies weights += delta, so the delta
+                        # must be the descent step (after − before)
+                        client.update_parameters(
+                            subtract_params(model.get_weights(), before)
+                        )
+
+                    self._supervised(sync_period)
+                # confirmed delivery: every pipelined push is acked (or
+                # sequence-deduplicated-resent) before this partition
+                # reports done — without this, a connection dying on
+                # the run's FINAL pushes would lose them silently
+                flush = getattr(client, "flush", None)
+                if flush is not None:
+                    self._supervised(flush)
         finally:
             if hasattr(client, "close"):
                 client.close()

@@ -45,6 +45,7 @@ _PS_DEADLINE_MODULES = (
     "test_native_ps",
     "test_ps_codec",
     "test_ps_overlap",
+    "test_fault_tolerance",
 )
 PS_TEST_DEADLINE_S = 120
 

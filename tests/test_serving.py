@@ -112,6 +112,42 @@ def test_slot_reclamation_and_midflight_admission(lm):
     )
 
 
+def test_raising_token_callback_reclaims_slot_and_engine_survives(lm):
+    """ISSUE 3 satellite: a per-token callback that raises mid-decode
+    fails only ITS request — error recorded, KV slot reclaimed — while
+    every other request (and later waves) keeps decoding. Before the
+    guard, the exception unwound through step() after the token was
+    recorded but before reclaim, leaking the slot for the engine's
+    life."""
+    from elephas_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(lm, num_slots=2)
+
+    def dying_consumer(token, done):
+        raise RuntimeError("downstream consumer died")
+
+    seen = []
+    bad = engine.submit(MIXED_PROMPTS[0], max_new_tokens=6,
+                        on_token=dying_consumer)
+    good = engine.submit(MIXED_PROMPTS[1], max_new_tokens=6,
+                         on_token=lambda tok, done: seen.append(tok))
+    engine.run()
+    assert isinstance(bad.error, RuntimeError) and bad.done
+    assert len(bad.tokens) == 1  # failed on its first token
+    # the healthy request decoded to completion, token-exactly
+    assert good.done and good.error is None and len(seen) == 6
+    np.testing.assert_array_equal(
+        np.asarray(good.full_sequence),
+        _one_shot(lm, MIXED_PROMPTS[1], 6, kv_cache=True),
+    )
+    # no slot leaked: both slots free, and a fresh full wave still runs
+    assert sorted(engine.scheduler._free) == list(range(engine.num_slots))
+    assert not engine.scheduler.active
+    reqs = [engine.submit(p, max_new_tokens=4) for p in MIXED_PROMPTS[:2]]
+    out = engine.run()
+    assert all(r.rid in out and r.error is None for r in reqs)
+
+
 def test_fixed_compile_count_across_waves(lm):
     """The compiled-shape contract (the recompile churn the one-shot
     path's jit cache papers over): across THREE waves of different
