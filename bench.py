@@ -401,8 +401,230 @@ def measure_weak_scaling():
     return results, efficiency
 
 
+def _serving_prefix_section(model, maxlen, vocab, num_slots,
+                            rounds=5):
+    """Shared-system-prompt workload (ISSUE 4): every request repeats
+    one long prefix + a short unique suffix — the dominant real-fleet
+    shape. Measured prefix-cache ON vs OFF in alternating rounds (same
+    honesty contract as the ps preset: a machine-regime shift hits both
+    configs inside each round; the median round is the headline).
+
+    TTFT comes from the engine's own ``token_times`` counters, not
+    wall-clock guesswork; the ON side reports hit requests only (the
+    claim is about hits — the cold first pass is the warmup). Also runs
+    a prefix-FREE workload through BOTH engines so a cache-on engine
+    provably does not tax unrelated traffic.
+
+    Runs UNMESHED (single replica): the latency sections measure
+    prefill work replaced by a local slot copy. With the slot axis
+    DP-sharded, the copy's donor gather crosses shards (a collective,
+    documented in ``prefix_copy``) and on this CPU gloo mesh that
+    transport — not the prefill compute the cache removes — dominates
+    the tiny bench model's TTFT; real deployments sharing prefixes
+    across DP replicas pay it once per admission, against a prefill
+    thousands of times costlier than this 2-layer d=64 stand-in."""
+    import numpy as np
+
+    from elephas_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(7)
+    # long shared prefix + short unique suffix, the system-prompt
+    # shape: cold pays the full top-ladder-bucket prefill, a hit pays
+    # one copy + a one-bucket suffix chunk
+    n_req, suffix_len, budget = 12, 6, 16
+    pre_len = maxlen - suffix_len - budget
+    shared = rng.integers(1, vocab, size=pre_len).astype(np.int32)
+    # donors must outlive the prefix-free churn: with fewer slots than
+    # requests the free workload evicts every shared donor and the
+    # steady-state hit rate collapses — size the arena for the claim
+    # being measured
+    num_slots = max(num_slots, n_req + 4)
+    workload = [
+        (np.concatenate([
+            shared, rng.integers(1, vocab, size=suffix_len).astype(np.int32)
+        ]), budget)
+        for _ in range(n_req)
+    ]
+    free_load = [
+        (rng.integers(1, vocab, size=int(16 + (i % 3) * 4)).astype(np.int32),
+         budget)
+        for i in range(n_req)
+    ]
+    engines = {}
+    for label, on in (("off", False), ("on", True)):
+        # min_reuse=4: coincidental 1-3 token prefixes on the random
+        # no-tax traffic admit cold, so that phase measures the real
+        # miss path (match walk + eviction churn) instead of sliding
+        # into shallow-copy territory
+        engines[label] = InferenceEngine(
+            model, num_slots=num_slots, prefix_cache=on,
+            prefix_min_reuse=4,
+        )
+        # warmup: compiles every program AND seeds the ON cache with
+        # donors — the measured rounds are the steady prefix-hit state
+        # (the second workload pass drives the copy + suffix-chunk
+        # programs through their compiles on the ON engine)
+        engines[label].run(workload)
+        engines[label].run(workload)
+        engines[label].run(free_load)
+
+    recs = {"off": [], "on": []}
+    free_tps = {"off": [], "on": []}
+    free_hits = 0
+    for _r in range(rounds):
+        # FRESH prefix-free prompts every round: resubmitting one fixed
+        # list would turn the ON engine's "no-tax" phase into near-full
+        # prefix hits after round 1 and the claim would never exercise
+        # the miss path (lengths keep the warmed bucket set)
+        free_round = [
+            (rng.integers(
+                1, vocab, size=int(16 + (i % 3) * 4)
+            ).astype(np.int32), budget)
+            for i in range(n_req)
+        ]
+        for label, eng in engines.items():
+            reqs = [eng.submit(p, mn) for p, mn in workload]
+            t0 = time.perf_counter()
+            eng.run()
+            dt = time.perf_counter() - t0
+            if dt <= MIN_CREDIBLE_DT:
+                raise ImplausibleTiming(
+                    f"serving prefix round {dt:.4f}s below the "
+                    f"{MIN_CREDIBLE_DT}s credibility floor"
+                )
+            sel = [
+                r for r in reqs
+                if label == "off" or r.reused_tokens > 0
+            ]
+            recs[label].append({
+                "ttft_ms": [r.ttft * 1e3 for r in sel],
+                "tok_s": sum(len(r.tokens) for r in reqs) / dt,
+                "hits": sum(1 for r in reqs if r.reused_tokens > 0),
+            })
+            hits0 = (
+                eng.scheduler.prefix_cache.hits if label == "on" else 0
+            )
+            reqs2 = [eng.submit(p, mn) for p, mn in free_round]
+            t0 = time.perf_counter()
+            eng.run()
+            dt2 = time.perf_counter() - t0
+            if label == "on":
+                free_hits += eng.scheduler.prefix_cache.hits - hits0
+            if dt2 <= MIN_CREDIBLE_DT:
+                raise ImplausibleTiming(
+                    f"serving prefix-free round {dt2:.4f}s below the "
+                    f"{MIN_CREDIBLE_DT}s credibility floor"
+                )
+            free_tps[label].append(
+                sum(len(r.tokens) for r in reqs2) / dt2
+            )
+
+    def med_ttft(label):
+        per_round = sorted(
+            float(np.percentile(r["ttft_ms"], 50)) for r in recs[label]
+        )
+        return per_round[(len(per_round) - 1) // 2]
+
+    ttft_off, ttft_on = med_ttft("off"), med_ttft("on")
+    cache = engines["on"].scheduler.prefix_cache.stats()
+    return {
+        "shared_prefix_len": pre_len,
+        "requests": n_req,
+        "ttft_ms_off": round(ttft_off, 2),
+        "ttft_ms_hit": round(ttft_on, 2),
+        "ttft_speedup": round(ttft_off / ttft_on, 2),
+        "hit_rate": round(
+            float(np.mean([r["hits"] for r in recs["on"]])) / n_req, 3
+        ),
+        "tok_s_off": round(
+            float(np.median([r["tok_s"] for r in recs["off"]])), 1
+        ),
+        "tok_s_on": round(
+            float(np.median([r["tok_s"] for r in recs["on"]])), 1
+        ),
+        "prefix_free_tok_s_off": round(
+            float(np.median(free_tps["off"])), 1
+        ),
+        "prefix_free_tok_s_on": round(
+            float(np.median(free_tps["on"])), 1
+        ),
+        "prefix_free_hits": free_hits,  # expect 0: pure miss path
+        "cache": cache,
+    }
+
+
+def _serving_interference_section(model, maxlen, vocab,
+                                  num_slots, chunk=16, rounds=3):
+    """Long-prompt interference (ISSUE 4): while short requests decode,
+    one long prompt arrives mid-flight. The blocking-wave engine runs
+    its whole prefill before the next decode window — every in-flight
+    request's next token waits; the chunked engine spends a bounded
+    token budget per step. Reported from the in-flight requests' OWN
+    inter-token counters (``Request.inter_token_times``), p99 over the
+    decode stream, median of alternating rounds."""
+    import numpy as np
+
+    from elephas_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(11)
+    # clamp both knobs so an oversized --serving-chunk can't abort the
+    # preset after the throughput section already ran: the engine
+    # rejects prefill_chunk > maxlen, and prompt + its 4-token budget
+    # must fit maxlen
+    chunk = min(chunk, maxlen)
+    long_len = min(max(chunk * 4, int(maxlen * 0.75)), maxlen - 4)
+    long_prompt = rng.integers(1, vocab, size=long_len).astype(np.int32)
+    shorts = [
+        (rng.integers(1, vocab, size=8).astype(np.int32),
+         min(48, maxlen - 16))
+        for _ in range(4)
+    ]
+    engines = {
+        "blocking": InferenceEngine(model, num_slots=num_slots),
+        "chunked": InferenceEngine(
+            model, num_slots=num_slots, prefill_chunk=chunk,
+        ),
+    }
+    for eng in engines.values():  # compile both paths before timing
+        eng.run(shorts + [(long_prompt, 4)])
+
+    p99s = {"blocking": [], "chunked": []}
+    for _r in range(rounds):
+        for label, eng in engines.items():
+            in_flight = [eng.submit(p, mn) for p, mn in shorts]
+            t0 = time.perf_counter()
+            for _ in range(3):  # get the shorts decoding
+                eng.step()
+            eng.submit(long_prompt, 4)  # the mid-flight long arrival
+            while eng.scheduler.has_work:
+                eng.step()
+            dt = time.perf_counter() - t0
+            if dt <= MIN_CREDIBLE_DT:
+                raise ImplausibleTiming(
+                    f"serving interference round {dt:.4f}s below the "
+                    f"{MIN_CREDIBLE_DT}s credibility floor"
+                )
+            itls = [
+                d for r in in_flight for d in r.inter_token_times
+            ]
+            p99s[label].append(float(np.percentile(itls, 99)) * 1e3)
+
+    med = {k: sorted(v)[(len(v) - 1) // 2] for k, v in p99s.items()}
+    return {
+        "long_prompt_len": long_len,
+        "prefill_chunk": chunk,
+        "inflight_itl_p99_ms_blocking": round(med["blocking"], 2),
+        "inflight_itl_p99_ms_chunked": round(med["chunked"], 2),
+        "itl_p99_rounds_blocking": [round(x, 2) for x in p99s["blocking"]],
+        "itl_p99_rounds_chunked": [round(x, 2) for x in p99s["chunked"]],
+        "itl_p99_improvement": round(
+            med["blocking"] / med["chunked"], 2
+        ),
+    }
+
+
 def measure_serving(n_requests: int, num_slots: int, backend: str,
-                    window: int = 8):
+                    window: int = 8, chunk: int = 16):
     """``--preset serving`` (ISSUE 1): aggregate decode throughput of
     the continuous-batching engine vs sequential one-shot
     ``generate()`` calls, on a mixed-length prompt workload over the
@@ -521,6 +743,37 @@ def measure_serving(n_requests: int, num_slots: int, backend: str,
     rounds.sort(key=lambda r: r["ratio"])
     mid = rounds[(len(rounds) - 1) // 2]
     compiles = engine.compile_stats()
+    eng_stats = engine.stats()  # TTFT / inter-token counters (ISSUE 4)
+    # the latency sections measure prefill COMPUTE replaced by a copy
+    # (prefix) or sliced into bounded chunks (interference). The tiny
+    # CI throughput model is dispatch-bound — per-program launch
+    # overhead, identical on both sides, buries the compute delta — so
+    # on CPU they run a deeper stand-in where prefill cost dominates
+    # the launch floor (on real accelerators the main model already is
+    # that regime)
+    if backend == "cpu":
+        lat_vocab, lat_model = 512, transformer_lm(
+            vocab_size=512, maxlen=maxlen, d_model=128, num_heads=4,
+            num_layers=4, dropout=0.0, seed=0,
+        )
+    else:
+        lat_vocab, lat_model = vocab, model
+    prefix = _serving_prefix_section(
+        lat_model, maxlen, lat_vocab, num_slots
+    )
+    interference = _serving_interference_section(
+        lat_model, maxlen, lat_vocab, num_slots, chunk=chunk
+    )
+    log.info(
+        "serving prefix cache: TTFT %.1fms cold vs %.1fms hit (%.1fx, "
+        "hit rate %.0f%%); chunked prefill: in-flight inter-token p99 "
+        "%.1fms blocking vs %.1fms chunked (%.1fx better)",
+        prefix["ttft_ms_off"], prefix["ttft_ms_hit"],
+        prefix["ttft_speedup"], prefix["hit_rate"] * 100,
+        interference["inflight_itl_p99_ms_blocking"],
+        interference["inflight_itl_p99_ms_chunked"],
+        interference["itl_p99_improvement"],
+    )
     log.info(
         "serving (median of %d rounds): %.1f tok/s continuous vs %.1f "
         "tok/s sequential (%.2fx; per-round %s), p50 %.0fms p99 %.0fms, "
@@ -549,6 +802,20 @@ def measure_serving(n_requests: int, num_slots: int, backend: str,
         "num_slots": engine.num_slots,
         "steps_per_sync": engine.steps_per_sync,
         "timed_dt": round(mid["srv_dt"], 3),
+        "ttft_p50_ms": round(
+            (eng_stats["ttft_s"]["p50"] or 0.0) * 1e3, 2
+        ),
+        "ttft_p99_ms": round(
+            (eng_stats["ttft_s"]["p99"] or 0.0) * 1e3, 2
+        ),
+        "itl_p50_ms": round(
+            (eng_stats["inter_token_s"]["p50"] or 0.0) * 1e3, 3
+        ),
+        "itl_p99_ms": round(
+            (eng_stats["inter_token_s"]["p99"] or 0.0) * 1e3, 3
+        ),
+        "prefix": prefix,
+        "interference": interference,
     }
 
 
@@ -911,6 +1178,10 @@ def main():
                    help="serving preset: decode steps per host sync "
                         "(multi-step scheduling; 1 = pure "
                         "iteration-level)")
+    p.add_argument("--serving-chunk", type=int, default=16,
+                   help="serving preset: prefill chunk size for the "
+                        "long-prompt interference section (tokens per "
+                        "budgeted prefill slice between decode windows)")
     p.add_argument("--model", choices=["resnet", "transformer"], default="resnet",
                    help="transformer = flash-attention encoder (matmul-"
                         "dominated secondary benchmark; the MXU ceiling "
@@ -1021,6 +1292,7 @@ def main():
                 max(1, args.serving_slots),
                 backend,
                 window=max(1, args.serving_window),
+                chunk=max(1, args.serving_chunk),
             )
         except ImplausibleTiming as e:
             log.error("serving bench implausible: %s — no JSON", e)

@@ -14,6 +14,10 @@ scheduling, vLLM-style slot/paged KV):
   queued requests into free slots, immediate reclamation on
   EOS/max-tokens, and bucketed prompt padding that keeps the compiled
   shape set small and fixed;
+- :mod:`elephas_tpu.serving.prefix_cache` — a deterministic radix
+  index over cached prompt prefixes (ISSUE 4): finished requests'
+  prompt K/V stays resident as donor slots with refcounts + LRU
+  eviction, so shared system prompts prefill once fleet-wide;
 - :mod:`elephas_tpu.serving.engine` — :class:`InferenceEngine`, the
   host-side driver (surfaced as ``SparkModel.serve()``): submit
   requests at any time, stream tokens back per request, run the same
@@ -21,7 +25,9 @@ scheduling, vLLM-style slot/paged KV):
 """
 
 from elephas_tpu.serving.engine import InferenceEngine  # noqa: F401
+from elephas_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from elephas_tpu.serving.scheduler import (  # noqa: F401
+    Admission,
     Request,
     Scheduler,
     bucket_for,

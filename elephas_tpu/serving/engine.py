@@ -41,10 +41,13 @@ import numpy as np
 
 from elephas_tpu.serving.kv_cache import (
     SlotKVCache,
+    chunked_prefill_forward,
     prefill_forward,
+    prefix_copy,
     token_decode_step,
 )
 from elephas_tpu.serving.scheduler import (
+    Admission,
     Request,
     Scheduler,
     default_buckets,
@@ -81,6 +84,19 @@ class InferenceEngine:
     ``top_p`` are engine-static sampling filters; per-request
     ``temperature`` rides as data (0 = greedy).
 
+    ``prefix_cache=True`` (ISSUE 4) keeps finished requests' prompt
+    K/V resident as donor slots under a deterministic radix index:
+    a new request sharing a prompt prefix pays one slot-to-slot copy
+    plus suffix-only prefill instead of recomputing the prefix.
+    ``prefill_chunk=c`` splits prefill into ``c``-token chunks run
+    under a per-step token budget (``prefill_budget``, default one
+    chunk) BETWEEN decode windows, so a long prompt arrival no longer
+    stalls every in-flight request's next token. Both compose; both
+    keep the compiled shape set closed (``compile_stats()``). Chunk
+    boundaries consume PRNG key splits, so temp>0 sampling streams
+    differ from the unchunked engine (still deterministic per
+    configuration); temperature-0 tokens are exact either way.
+
     PP ring decode is not integrated yet — construct via
     ``SparkModel.serve()`` on a DP/TP mesh, or directly on no mesh.
     """
@@ -88,7 +104,11 @@ class InferenceEngine:
     def __init__(self, model, num_slots: int = 8, mesh=None,
                  batch_axes=("data",), model_axis=None, rules=None,
                  top_k: int | None = None, top_p: float | None = None,
-                 seed: int = 0, buckets=None, steps_per_sync: int = 1):
+                 seed: int = 0, buckets=None, steps_per_sync: int = 1,
+                 prefix_cache: bool = False,
+                 prefix_min_reuse: int = 1,
+                 prefill_chunk: int | None = None,
+                 prefill_budget: int | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -149,16 +169,51 @@ class InferenceEngine:
                     f"a bucket beyond maxlen would overflow the KV arena"
                 )
 
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if not 0 < prefill_chunk <= self.maxlen:
+                raise ValueError(
+                    f"prefill_chunk={prefill_chunk} outside "
+                    f"(0, maxlen={self.maxlen}]"
+                )
+        self.prefill_chunk = prefill_chunk
+        if prefill_budget is not None:
+            if prefill_chunk is None:
+                raise ValueError(
+                    "prefill_budget requires prefill_chunk — without "
+                    "chunking, prefill is a single blocking wave and "
+                    "the budget would be silently ignored"
+                )
+            if int(prefill_budget) < 1:
+                raise ValueError(f"prefill_budget={prefill_budget} < 1")
+        # per-step() prefill token budget (chunked mode): default one
+        # chunk's worth — the typical long-prompt arrival streams in at
+        # one chunk per decode window, bounding in-flight inter-token
+        # latency at roughly one chunk of extra compute
+        self._prefill_budget = (
+            int(prefill_budget) if prefill_budget is not None
+            else (prefill_chunk or 0)
+        )
+
         self.arena = SlotKVCache(
             flash_layers, self.num_slots, self.maxlen,
             mesh=mesh, batch_axes=self.batch_axes, model_axis=model_axis,
         )
         self.scheduler = Scheduler(
-            self.num_slots, buckets or default_buckets(self.maxlen)
+            self.num_slots, buckets or default_buckets(self.maxlen),
+            prefix_cache=prefix_cache,
+            prefix_min_reuse=prefix_min_reuse,
         )
         self._rules = rules
         self._seed = int(seed)
         self.total_generated = 0
+        # slots mid-chunked-prefill: slot -> [Admission, progress]
+        # (progress = prompt tokens already resident, incl. any copied
+        # prefix; the slot joins decode only once progress == len(prompt))
+        self._prefilling: dict[int, list] = {}
+        # slots whose in-flight prefill straddled a weight refresh —
+        # their rows mix weight generations and never become donors
+        self._stale_prefill: set[int] = set()
         # completed requests, BOUNDED: a server alive for millions of
         # requests must not grow host memory linearly — callers keep
         # their own Request handles from submit(); this registry only
@@ -166,6 +221,10 @@ class InferenceEngine:
         self.finished: dict[int, Request] = {}
         self._finished_bound = 4096
         self.finished_count = 0
+        # eviction from `finished` is LOUD (ISSUE 4 satellite): counter
+        # + warning, and requests of an in-flight run() call are exempt
+        self.finished_evicted = 0
+        self._protected: set[int] = set()
 
         maxlen, arena = self.maxlen, self.arena
 
@@ -233,21 +292,29 @@ class InferenceEngine:
         k_window = max(1, int(steps_per_sync))
         self.steps_per_sync = k_window
 
-        def decode(w, caches, lengths, last, temps, key):
+        def decode(w, caches, lengths, last, temps, active, key):
+            # `active` masks idle / mid-chunked-prefill / prefix-donor
+            # slots OUT of the cache write and cursor advance — their
+            # resident rows must survive the window; active slots' math
+            # is untouched (bit-identical to the unmasked program)
             def body(i, carry):
                 caches, lengths, last, key, toks = carry
                 positions = jnp.minimum(lengths, maxlen - 1)
                 logits, caches = token_decode_step(
-                    model, w, last, positions, caches, maxlen
+                    model, w, last, positions, caches, maxlen,
+                    active=active,
                 )
                 caches = _constrain_all(caches)
                 key, sub = jax.random.split(key)
                 sampled = _sample_dynamic(
                     logits, sub, temps, self.top_k, self.top_p
                 )
-                lengths = _vec(jnp.minimum(lengths + 1, maxlen))
+                lengths = _vec(jnp.where(
+                    active, jnp.minimum(lengths + 1, maxlen), lengths
+                ))
                 toks = toks.at[i].set(sampled)
-                return caches, lengths, _vec(sampled), key, toks
+                last = _vec(jnp.where(active, sampled, last))
+                return caches, lengths, last, key, toks
 
             toks0 = jnp.zeros((k_window, self.num_slots), jnp.int32)
             caches, lengths, last, key, toks = jax.lax.fori_loop(
@@ -255,15 +322,77 @@ class InferenceEngine:
             )
             return caches, lengths, last, key, toks
 
+        def chunk_step(w, caches, lengths, last, temps, tokens, offs,
+                       clens, act, fin, p_lens, new_temps,
+                       src_idx, copy_mask, copy_len, key,
+                       has_copy: bool):
+            """One bounded prefill chunk for every slot in ``act`` —
+            cold chunked prefill and post-copy suffix prefill alike.
+            Slots in ``fin`` end their prompt inside this chunk: their
+            first token samples from the prompt-end logits row and they
+            join the decode population.
+
+            Prefix-cache transplants FUSE into this program (``src_idx``
+            / ``copy_mask`` / ``copy_len``; all-False mask = no copy,
+            same compiled shape): a hit admission whose suffix prefills
+            immediately pays ONE dispatch, not copy-then-chunk — on
+            dispatch-bound backends the launch overhead rivals the tiny
+            suffix compute itself. The standalone copy program below
+            stays for chunked-queue admissions, where the copy must
+            land while the wave still pins the donor but the first
+            chunk call may be budget-deferred to a later step.
+
+            ``has_copy`` is STATIC: the donor gather costs O(slots² ·
+            maxlen · H · Dh) per layer whether or not the mask selects
+            anything (the mask is runtime data XLA cannot elide), so
+            copy-free calls — every budgeted chunk in chunked mode —
+            trace a variant without it. Two entries per width at most,
+            and each mode only ever uses one."""
+            if has_copy:
+                caches = _constrain_all(prefix_copy(
+                    caches, src_idx, copy_mask, copy_len, maxlen
+                ))
+            logits, caches = chunked_prefill_forward(
+                model, w, tokens, caches, offs, clens, act, maxlen
+            )
+            caches = _constrain_all(caches)
+            C = tokens.shape[1]
+            at_end = (
+                (p_lens - offs - 1)[:, None] == jnp.arange(C)[None, :]
+            ).astype(logits.dtype)
+            last_logits = jnp.einsum("bc,bcv->bv", at_end, logits)
+            key, sub = jax.random.split(key)
+            firsts = _sample_dynamic(
+                last_logits, sub, new_temps, self.top_k, self.top_p
+            )
+            lengths = _vec(jnp.where(fin, p_lens, lengths))
+            last = _vec(jnp.where(fin, firsts, last))
+            temps = _vec(jnp.where(fin, new_temps, temps))
+            return caches, lengths, last, temps, key, firsts
+
+        def copy_prefix(caches, src_idx, copy_mask, copy_len):
+            return _constrain_all(
+                prefix_copy(caches, src_idx, copy_mask, copy_len, maxlen)
+            )
+
         # the fixed program set: ONE decode window + one prefill per
         # prompt bucket (p_lens/admit/new_temps ride as traced vectors,
-        # so only the bucket SHAPE triggers a compile)
+        # so only the bucket SHAPE triggers a compile), plus ONE prefix
+        # copy shape and one chunk program per chunk width (a single
+        # width under `prefill_chunk`, suffix buckets otherwise)
         self._init_jit = jax.jit(init_state)
         self._prefill_jit = jax.jit(
             prefill, donate_argnums=(1, 2, 3, 4, 9)
         )  # args: w, caches, lengths, last, temps, rows, p_lens,
         #         admit, new_temps, key
-        self._decode_jit = jax.jit(decode, donate_argnums=(1, 2, 3, 5))
+        self._decode_jit = jax.jit(decode, donate_argnums=(1, 2, 3, 6))
+        self._chunk_jit = jax.jit(
+            chunk_step, donate_argnums=(1, 2, 3, 4, 15),
+            static_argnums=(16,),
+        )  # args: w, caches, lengths, last, temps, tokens, offs,
+        #         clens, act, fin, p_lens, new_temps, src_idx,
+        #         copy_mask, copy_len, key, has_copy (static)
+        self._copy_jit = jax.jit(copy_prefix, donate_argnums=(0,))
 
         self.refresh_weights()
         self._caches, self._lengths, self._last, self._temps = (
@@ -272,6 +401,12 @@ class InferenceEngine:
         self._key = self._stage(
             np.asarray(jax.random.PRNGKey(self._seed))
         )
+        # decode-active mask: host mirror + staged device copy,
+        # re-uploaded only when membership changes (admission finalize /
+        # reclaim), not every window
+        self._active_host = np.zeros((self.num_slots,), bool)
+        self._active_dev = self._stage_slots(self._active_host.copy())
+        self._active_dirty = False
 
     # -- device staging ------------------------------------------------
 
@@ -297,8 +432,26 @@ class InferenceEngine:
     def refresh_weights(self) -> None:
         """(Re-)upload the model's weights — call after further
         training; the compiled programs take them as arguments, so no
-        recompile happens."""
+        recompile happens.
+
+        Flushes the prefix cache: resident donor K/V was computed
+        under the OLD weights, and a donor copy would silently splice
+        stale rows into a new-weights request — breaking the engine's
+        token-exactness contract with no error. (In-flight requests
+        keep their slots and finish on mixed weights, the same
+        documented behavior as refreshing mid-decode.)"""
         import jax.numpy as jnp
+
+        # guarded for the constructor's first call (scheduler not
+        # built yet — nothing cached before weights exist)
+        scheduler = getattr(self, "scheduler", None)
+        if scheduler is not None:
+            scheduler.flush_prefix_cache()
+            # slots mid-chunked-prefill hold rows partially computed
+            # under the OLD weights: when they finalize they must NOT
+            # re-register as donors, or the stale-splice the flush
+            # prevents comes back through the side door
+            self._stale_prefill = set(self._prefilling)
 
         if self.mesh is None:
             self._weights = {
@@ -343,8 +496,10 @@ class InferenceEngine:
             raise ValueError(f"temperature={temperature} < 0")
         # fail HERE, not mid-flight in the prefill wave (where the
         # request would already hold a leased slot): a custom bucket
-        # ladder may top out below the model's maxlen
-        self.scheduler.bucket_for(p)
+        # ladder may top out below the model's maxlen. Chunked prefill
+        # never pads to a prompt bucket, so the ladder doesn't bound it.
+        if not self.prefill_chunk:
+            self.scheduler.bucket_for(p)
         req = self.scheduler.make_request(
             prompt, max_new_tokens, temperature=temperature, eos_id=eos_id,
             on_token=on_token,
@@ -363,6 +518,7 @@ class InferenceEngine:
         the KV slot for the engine's lifetime."""
         self.total_generated += 1
         slot = req.slot
+        req.token_times.append(time.perf_counter())
         done = self.scheduler.on_token(slot, token)
         if req.on_token is not None:
             try:
@@ -376,13 +532,54 @@ class InferenceEngine:
                     "slot %d reclaimed, engine continues", req.rid, e, slot,
                 )
         if done:
-            req.finish_time = time.perf_counter()
+            req.finish_time = req.token_times[-1]
             self.scheduler.reclaim(slot)
+            self._set_active(slot, False)
             self.finished_count += 1
             self.finished[req.rid] = req
-            while len(self.finished) > self._finished_bound:
-                self.finished.pop(next(iter(self.finished)))
+            self._evict_finished()
         return done
+
+    def _evict_finished(self) -> None:
+        """Trim the bounded finished-request registry — LOUDLY (warning
+        + ``finished_evicted`` counter; silent eviction lost results
+        under slow consumers), and never evicting a request an
+        in-flight :meth:`run` call has yet to return (the registry may
+        temporarily exceed its bound instead)."""
+        while len(self.finished) > self._finished_bound:
+            if len(self.finished) - len(self._protected) <= 0:
+                return  # only protected residents over the bound — a
+                # full scan would find no victim (hot path: this runs
+                # per token completion during a large run())
+            victim = next(
+                (rid for rid in self.finished
+                 if rid not in self._protected),
+                None,
+            )
+            if victim is None:
+                return  # every resident request is protected
+            self.finished.pop(victim)
+            self.finished_evicted += 1
+            if self.finished_evicted == 1 or \
+                    self.finished_evicted % 1024 == 0:
+                logger.warning(
+                    "finished-request registry hit its bound (%d): "
+                    "evicted request %d (%d evicted so far) — consume "
+                    "results promptly or keep your own Request handles "
+                    "from submit()",
+                    self._finished_bound, victim, self.finished_evicted,
+                )
+
+    def _set_active(self, slot: int, value: bool) -> None:
+        if bool(self._active_host[slot]) != value:
+            self._active_host[slot] = value
+            self._active_dirty = True
+
+    def _sync_active(self):
+        if self._active_dirty:
+            self._active_dev = self._stage_slots(self._active_host.copy())
+            self._active_dirty = False
+        return self._active_dev
 
     def _stage_slots(self, arr):
         """Host ``[num_slots, ...]`` value → device, slot axis over the
@@ -427,32 +624,186 @@ class InferenceEngine:
             )
             toks = self._host(firsts)
             for req in reqs:
+                # prompt rows are resident from here: index them before
+                # _emit (a 1-token request reclaims inside _emit, and
+                # reclaim only retains slots the cache already knows)
+                self.scheduler.on_prefill_complete(req)
+                self._set_active(req.slot, True)
                 self._emit(req, int(toks[req.slot]))
 
+    def _copy_vectors(self, copies):
+        """``(src_idx, copy_mask, copy_len)`` staging vectors for a
+        wave's donor transplants — shared by the fused (in-chunk) and
+        standalone copy program calls so their semantics cannot
+        diverge."""
+        src = np.zeros((self.num_slots,), np.int32)
+        mask = np.zeros((self.num_slots,), bool)
+        clen = np.zeros((self.num_slots,), np.int32)
+        for a in copies:
+            src[a.slot] = a.donor_slot
+            mask[a.slot] = True
+            clen[a.slot] = a.reuse_len
+        return src, mask, clen
+
+    def _run_chunk(self, items: list, width: int, copies=()):
+        """One chunk-program call: each ``(admission, progress, take)``
+        item advances ``take`` prompt tokens (``<= width``) of its
+        slot's prompt from absolute offset ``progress``. Items whose
+        prompt completes sample their first token and join decode.
+        ``copies`` — admissions whose donor transplant rides fused
+        inside this same call (their suffix items must be present too).
+        Returns ``(request, token, done)`` emissions of finalized
+        requests."""
+        rows = np.zeros((self.num_slots, width), np.int32)
+        offs = np.zeros((self.num_slots,), np.int32)
+        clens = np.zeros((self.num_slots,), np.int32)
+        act = np.zeros((self.num_slots,), bool)
+        fin = np.zeros((self.num_slots,), bool)
+        p_lens = np.zeros((self.num_slots,), np.int32)
+        new_temps = np.zeros((self.num_slots,), np.float32)
+        src, cmask, clen = self._copy_vectors(copies)
+        finalized = []
+        for adm, progress, take in items:
+            req, slot = adm.req, adm.slot
+            rows[slot, :take] = req.prompt[progress:progress + take]
+            offs[slot] = progress
+            clens[slot] = take
+            act[slot] = True
+            done_prefill = progress + take == len(req.prompt)
+            fin[slot] = done_prefill
+            p_lens[slot] = len(req.prompt)
+            new_temps[slot] = req.temperature
+            if done_prefill:
+                finalized.append(adm)
+        (self._caches, self._lengths, self._last, self._temps,
+         self._key, firsts) = self._chunk_jit(
+            self._weights, self._caches, self._lengths, self._last,
+            self._temps, self._stage_slots(rows),
+            self._stage_slots(offs), self._stage_slots(clens),
+            self._stage_slots(act), self._stage_slots(fin),
+            self._stage_slots(p_lens), self._stage_slots(new_temps),
+            self._stage_slots(src), self._stage_slots(cmask),
+            self._stage_slots(clen), self._key, bool(copies),
+        )
+        emitted = []
+        if finalized:
+            toks = self._host(firsts)
+            for adm in finalized:
+                req = adm.req
+                self._prefilling.pop(adm.slot, None)
+                if adm.slot in self._stale_prefill:
+                    # prefill straddled refresh_weights(): rows mix
+                    # weight generations — decode fine, donate never
+                    self._stale_prefill.discard(adm.slot)
+                else:
+                    self.scheduler.on_prefill_complete(req)
+                self._set_active(adm.slot, True)
+                self._emit(req, int(toks[adm.slot]))
+                emitted.append((req, req.tokens[-1], req.done))
+        return emitted
+
+    def _admit_wave(self, plan: list[Admission]):
+        """Execute one admission wave. Without chunking: full-bucket
+        prefill for the cold requests (legacy wave), and for prefix
+        hits ONE fused copy+suffix-chunk call per suffix bucket. With
+        chunking: the wave's copies land NOW in one standalone
+        copy-program call (the donors are only pinned through this
+        wave — a budget-deferred chunk must not read a maybe-evicted
+        donor later), then everything queues for budgeted chunks."""
+        emitted: list[tuple[Request, int, bool]] = []
+        copies = [a for a in plan if a.donor_slot is not None]
+        if self.prefill_chunk:
+            if copies:
+                src, mask, clen = self._copy_vectors(copies)
+                self._caches = self._copy_jit(
+                    self._caches, self._stage_slots(src),
+                    self._stage_slots(mask), self._stage_slots(clen),
+                )
+            for a in plan:
+                self._prefilling[a.slot] = [a, a.reuse_len]
+            return emitted
+        cold = [a.req for a in plan if a.donor_slot is None]
+        if cold:
+            self._prefill_wave(cold)
+            emitted.extend(
+                (req, req.tokens[-1], req.done) for req in cold
+            )
+        # fused copy + suffix-only prefill of the hits, one chunk call
+        # per suffix bucket (widths stay inside the closed ladder)
+        by_width: dict[int, list] = {}
+        for a in copies:
+            suffix = len(a.req.prompt) - a.reuse_len
+            by_width.setdefault(
+                self.scheduler.bucket_for(suffix), []
+            ).append((a, a.reuse_len, suffix))
+        for width in sorted(by_width):
+            emitted.extend(self._run_chunk(
+                by_width[width], width,
+                copies=[a for a, _p, _t in by_width[width]],
+            ))
+        return emitted
+
+    def _prefill_progress(self):
+        """Spend this step's prefill token budget on chunk calls: every
+        mid-prefill slot advances by up to ``prefill_chunk`` tokens per
+        call, calls repeat until the budget is spent or the queue
+        drains. Decode windows run BETWEEN these budgeted slices — the
+        whole point: a long prompt streams in without stalling in-flight
+        requests' next tokens."""
+        emitted: list[tuple[Request, int, bool]] = []
+        if not self._prefilling:
+            return emitted
+        budget = self._prefill_budget
+        while self._prefilling and budget > 0:
+            # the budget caps TOTAL prefill tokens this step, not per
+            # call: with several long prompts mid-prefill, slots beyond
+            # the budget wait for the next step (lowest slot first,
+            # deterministic) — otherwise N concurrent arrivals would
+            # cost N×chunk per step and in-flight inter-token latency
+            # would scale with arrival count, the exact stall this
+            # budget exists to bound
+            items = []
+            for slot in sorted(self._prefilling):
+                if budget <= 0:
+                    break
+                adm, progress = self._prefilling[slot]
+                take = min(
+                    self.prefill_chunk, len(adm.req.prompt) - progress
+                )
+                items.append((adm, progress, take))
+                budget -= take
+            emitted.extend(self._run_chunk(items, self.prefill_chunk))
+            for adm, progress, take in items:
+                if adm.slot in self._prefilling:
+                    self._prefilling[adm.slot][1] = progress + take
+        return emitted
+
     def step(self) -> list[tuple[Request, int, bool]]:
-        """One engine iteration: admission+prefill of waiting requests
-        into free slots, then one arena-wide decode window of
-        ``steps_per_sync`` steps. Returns ``(request, token, done)``
+        """One engine iteration: admission of waiting requests into
+        free slots (prefix-cache copies + prefill — full-wave, or
+        budgeted chunks interleaved with decode), then one arena-wide
+        decode window of ``steps_per_sync`` steps over the slots whose
+        prefill has completed. Returns ``(request, token, done)``
         triples in generation order (a request can appear several
         times: its prefill token plus one per window position); the
         ``done`` flag is per-TOKEN — True only on a request's final
         token, so stream consumers can stop at it without dropping
         tokens."""
         emitted: list[tuple[Request, int, bool]] = []
-        admitted = self.scheduler.admit()
-        if admitted:
-            self._prefill_wave(admitted)
-            # before any decode token, so req.done here is the prefill
-            # token's own flag
-            emitted.extend(
-                (req, req.tokens[-1], req.done) for req in admitted
-            )
-        if not self.scheduler.active:
+        plan = self.scheduler.admit()
+        if plan:
+            # admission emissions land before any decode token, so
+            # req.done there is the prefill token's own flag
+            emitted.extend(self._admit_wave(plan))
+        emitted.extend(self._prefill_progress())
+        if not any(
+            slot not in self._prefilling for slot in self.scheduler.active
+        ):
             return emitted
         (self._caches, self._lengths, self._last, self._key,
          window) = self._decode_jit(
             self._weights, self._caches, self._lengths, self._last,
-            self._temps, self._key,
+            self._temps, self._sync_active(), self._key,
         )
         toks = self._host(window)  # [steps_per_sync, num_slots]
         for i in range(self.steps_per_sync):
@@ -460,6 +811,8 @@ class InferenceEngine:
                 break  # window tail decoded garbage for empty slots
             self.scheduler.note_step()
             for slot, req in sorted(self.scheduler.active.items()):
+                if slot in self._prefilling:
+                    continue  # mid-prefill: no decode tokens yet
                 done = self._emit(req, int(toks[i, slot]))
                 emitted.append((req, req.tokens[-1], done))
         return emitted
@@ -477,21 +830,32 @@ class InferenceEngine:
         """Convenience batch driver: optionally submit ``requests``
         (an iterable of ``(prompt, max_new_tokens)`` pairs or kwargs
         dicts), drive the engine until idle, and return
-        ``{request_id: full token sequence (prompt + generated)}``."""
+        ``{request_id: full token sequence (prompt + generated)}``.
+
+        Requests submitted through THIS call are exempt from the
+        bounded finished-registry eviction until it returns — a huge
+        batch cannot silently lose its own oldest results."""
+        submitted: list[Request] = []
         if requests is not None:
             for r in requests:
                 if isinstance(r, dict):
-                    self.submit(**r)
+                    submitted.append(self.submit(**r))
                 else:
                     prompt, max_new = r
-                    self.submit(prompt, max_new)
-        drained: dict[int, np.ndarray] = {}
-        while self.scheduler.has_work:
-            for req, _tok, done in self.step():
-                if done:
-                    drained[req.rid] = np.asarray(
-                        req.full_sequence, np.int32
-                    )
+                    submitted.append(self.submit(prompt, max_new))
+        protected = {r.rid for r in submitted} - self._protected
+        self._protected |= protected
+        try:
+            drained: dict[int, np.ndarray] = {}
+            while self.scheduler.has_work:
+                for req, _tok, done in self.step():
+                    if done:
+                        drained[req.rid] = np.asarray(
+                            req.full_sequence, np.int32
+                        )
+        finally:
+            self._protected -= protected
+            self._evict_finished()  # deferred trim, still loud
         return drained
 
     # -- introspection -------------------------------------------------
@@ -511,23 +875,49 @@ class InferenceEngine:
         return {
             "decode_compiles": n(self._decode_jit),
             "prefill_compiles": n(self._prefill_jit),
+            "chunk_prefill_compiles": n(self._chunk_jit),
+            "copy_compiles": n(self._copy_jit),
             "buckets": tuple(self.scheduler.buckets),
+            "prefill_chunk": self.prefill_chunk,
+        }
+
+    @staticmethod
+    def _percentiles(xs) -> dict:
+        """``{p50, p99, n}`` summary (seconds) of a latency sample."""
+        if not xs:
+            return {"p50": None, "p99": None, "n": 0}
+        return {
+            "p50": float(np.percentile(xs, 50)),
+            "p99": float(np.percentile(xs, 99)),
+            "n": len(xs),
         }
 
     def stats(self) -> dict:
         """Serving counters for the bench: aggregate generated tokens,
-        decode steps, mean slot occupancy, and per-request latencies
-        (seconds) of finished requests."""
+        decode steps, mean slot occupancy, per-request whole-request
+        latencies, TTFT (submit→first token) and inter-token arrival
+        percentiles of finished requests (ISSUE 4 — the chunked-prefill
+        and prefix-reuse wins read straight off these counters), plus
+        prefix-cache hit/eviction counters when the cache is on."""
+        finished = list(self.finished.values())
         lat = [
             r.finish_time - r.submit_time
-            for r in self.finished.values()
+            for r in finished
             if r.finish_time is not None and r.submit_time is not None
         ]
-        return {
+        ttfts = [r.ttft for r in finished if r.ttft is not None]
+        itls = [d for r in finished for d in r.inter_token_times]
+        out = {
             "total_generated": self.total_generated,
             "decode_steps": self.scheduler._steps,
             "occupancy": self.scheduler.occupancy,
             "latencies": lat,
             "finished": self.finished_count,
+            "finished_evicted": self.finished_evicted,
             "num_slots": self.num_slots,
+            "ttft_s": self._percentiles(ttfts),
+            "inter_token_s": self._percentiles(itls),
         }
+        if self.scheduler.prefix_cache is not None:
+            out["prefix_cache"] = self.scheduler.prefix_cache.stats()
+        return out

@@ -115,6 +115,48 @@ def _slice_seq_prefix(a, s, maxlen):
     return a
 
 
+def _rows_at_position_matrix(table, pos_mat):
+    """``table[pos_mat]`` for a ``[B, C]`` position matrix as a one-hot
+    contraction (``[B, C, D]`` out) — the chunked-prefill analogue of
+    :func:`_rows_at_positions`: each slot's chunk sits at its own
+    absolute offset, and per-row dynamic gathers on mesh-sharded
+    operands would lower to collectives. Out-of-range positions (the
+    padded tail of a final partial chunk) produce exact zero rows,
+    which feed only masked-off garbage lanes."""
+    import jax.numpy as jnp
+
+    onehot = (
+        pos_mat[:, :, None] == jnp.arange(table.shape[0])[None, None, :]
+    )
+    if jnp.issubdtype(table.dtype, jnp.floating):
+        return jnp.einsum(
+            "bcm,md->bcd", onehot.astype(table.dtype), table
+        )
+    gathered = jnp.where(
+        onehot[..., None], table[None, None], 0
+    ).sum(axis=2)
+    return gathered.astype(table.dtype)
+
+
+def _slice_seq_at_position_matrix(a, pos_mat, maxlen):
+    """Chunk-time analogue of ``_slice_seq_prefix``: concrete arrays
+    spanning the sequence axis follow each slot's absolute chunk
+    positions (``[.., maxlen, D]`` → ``[B, C, D]``, ``[maxlen]`` →
+    ``[B, C]``). Traced tensors pass through."""
+    import jax.numpy as jnp
+
+    if not _is_concrete(a):
+        return a
+    arr = jnp.asarray(a)
+    if arr.ndim >= 2 and arr.shape[-2] == maxlen:
+        return _rows_at_position_matrix(
+            _squeeze_table(arr, maxlen), pos_mat
+        )
+    if arr.ndim == 1 and arr.shape[0] == maxlen:
+        return _rows_at_position_matrix(arr[:, None], pos_mat)[..., 0]
+    return a
+
+
 class SlotKVCache:
     """Specs + sharding rules for the slot arena of one model.
 
@@ -189,7 +231,8 @@ class SlotKVCache:
         }
 
 
-def token_decode_step(model, w, tok, positions, caches, maxlen):
+def token_decode_step(model, w, tok, positions, caches, maxlen,
+                      active=None):
     """One decode step for the WHOLE arena: slot ``i`` consumes token
     ``tok[i]`` at position ``positions[i]`` (its write cursor), writes
     that position's K/V into its arena row, attends over positions
@@ -199,6 +242,12 @@ def token_decode_step(model, w, tok, positions, caches, maxlen):
     (einsum strings and operation order kept identical so slot-decoded
     tokens match one-shot ``generate()`` exactly at temperature 0) —
     the only generalization is the vector cursor.
+
+    ``active`` (``[num_slots]`` bool, optional) masks the cache WRITE:
+    slots that are idle, mid-chunked-prefill, or resident prefix-cache
+    donors must not have garbage K/V scribbled at their cursor while
+    the rest of the arena decodes (ISSUE 4). Active slots' math is
+    untouched — bit-identical with or without the mask.
 
     Returns ``(logits [num_slots, vocab], new_caches)``."""
     import jax
@@ -214,6 +263,8 @@ def token_decode_step(model, w, tok, positions, caches, maxlen):
     write_mask = (
         positions[:, None] == jnp.arange(maxlen)[None, :]
     )[:, :, None, None]
+    if active is not None:
+        write_mask = write_mask & active[:, None, None, None]
 
     def handler(op):
         if isinstance(op, FlashMHA):
@@ -393,3 +444,186 @@ def prefill_forward(model, w, tokens_rows, caches, admit_mask, maxlen):
     return logits, {
         name: ctx_new.get(name, caches[name]) for name in caches
     }
+
+
+def chunked_prefill_forward(model, w, tokens_chunk, caches, offsets,
+                            chunk_lens, active, maxlen):
+    """Prefill a bounded CHUNK of each active slot's prompt, resuming
+    from per-slot absolute offsets (ISSUE 4) — the program behind both
+    chunked prefill (long prompts stream in ``prefill_chunk``-token
+    slices between decode windows instead of stalling them) and
+    suffix-only prefill after a prefix-cache copy.
+
+    Unlike :func:`prefill_forward` (whole bucket, in-chunk causal
+    attention, always from position 0), a chunk's queries must attend
+    to K/V that already sits in the arena — rows written by the prefix
+    copy and by earlier chunks — so attention here runs over the
+    full cache row (masked to ``position <= query position``), after
+    this chunk's own K/V rows land.
+
+    ``tokens_chunk``: ``[num_slots, C]`` int32 — slot ``b``'s prompt
+    tokens for absolute positions ``offsets[b] .. offsets[b]+C-1``,
+    compiled once per chunk width ``C`` (a closed set: ONE width when
+    ``prefill_chunk`` is fixed, suffix buckets from the scheduler
+    ladder otherwise). ``chunk_lens[b] <= C`` masks a final partial
+    chunk's padded tail off the cache write; ``active`` masks slots not
+    prefilling this call. Padded/inactive lanes compute garbage that is
+    never written and never read.
+
+    Returns ``(logits [num_slots, C, vocab], new_caches)`` — the
+    caller samples a finalizing slot's first token from the logits row
+    at its prompt-end chunk index.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import keras
+
+    FlashMHA = _flash_mha_layer()
+    ctx_new = {}
+    C = int(tokens_chunk.shape[1])
+    # absolute positions of each slot's chunk rows, and the cache-write
+    # select: chunk index i lands at cache row offsets[b]+i iff it is a
+    # real (unpadded) token of an active slot — one-hot over the
+    # sequence axis, slot-local under the mesh like the decode cursor
+    pos_mat = offsets[:, None] + jnp.arange(C)[None, :]  # [B, C]
+    valid = (
+        active[:, None] & (jnp.arange(C)[None, :] < chunk_lens[:, None])
+    )  # [B, C]
+    write_sel = (
+        pos_mat[:, None, :] == jnp.arange(maxlen)[None, :, None]
+    ) & valid[:, None, :]  # [B, maxlen, C]
+
+    def handler(op):
+        if isinstance(op, FlashMHA):
+            def attn(x, *_a, **_k):
+                ck, cv = caches[op.name]
+                H, Dh = op.num_heads, op.head_dim
+                B = x.shape[0]
+                qkv = jnp.reshape(
+                    x @ w[op.qkv.kernel.path], (B, C, 3, H, Dh)
+                )
+                qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3,B,H,C,Dh]
+                q, k, v = qkv[0], qkv[1], qkv[2]
+                if getattr(op, "rope", False):
+                    cos_np, sin_np = _rope_tables(maxlen, Dh)
+                    cos = _rows_at_position_matrix(
+                        jnp.asarray(cos_np), pos_mat
+                    )[:, None]  # [B, 1, C, Dh]
+                    sin = _rows_at_position_matrix(
+                        jnp.asarray(sin_np), pos_mat
+                    )[:, None]
+                    q = _apply_rope(q, cos, sin)
+                    k = _apply_rope(k, cos, sin)
+                # land this chunk's K/V rows FIRST, then attend over the
+                # updated arena row — queries see the prefix copy,
+                # earlier chunks, and their own chunk's causal part
+                k_rows = jnp.transpose(k, (0, 2, 1, 3))  # [B, C, H, Dh]
+                v_rows = jnp.transpose(v, (0, 2, 1, 3))
+                scat_k = jnp.einsum(
+                    "bsc,bchd->bshd", write_sel.astype(ck.dtype), k_rows
+                )
+                scat_v = jnp.einsum(
+                    "bsc,bchd->bshd", write_sel.astype(cv.dtype), v_rows
+                )
+                covered = jnp.any(write_sel, axis=2)[:, :, None, None]
+                ck = jnp.where(covered, scat_k, ck)
+                cv = jnp.where(covered, scat_v, cv)
+                att = jnp.einsum("bhcd,bshd->bhcs", q, ck) * (Dh**-0.5)
+                visible = (
+                    jnp.arange(maxlen)[None, None, None, :]
+                    <= pos_mat[:, None, :, None]
+                )
+                att = jax.nn.softmax(
+                    jnp.where(visible, att, -jnp.inf), axis=-1
+                )
+                o = jnp.einsum("bhcs,bshd->bhcd", att, cv)
+                o = jnp.reshape(
+                    jnp.transpose(o, (0, 2, 1, 3)), (B, C, H * Dh)
+                )
+                ctx_new[op.name] = (ck, cv)
+                return (
+                    o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
+                )
+
+            return attn
+        if isinstance(op, keras.layers.Dropout):
+            return lambda x, *a, **k: x
+        if isinstance(op, keras.Layer) and op.variables:
+            def stateless(*args, _op=op, **kwargs):
+                if kwargs.get("training"):
+                    kwargs["training"] = False
+                args = [
+                    _slice_seq_at_position_matrix(a, pos_mat, maxlen)
+                    for a in args
+                ]
+                tv = [w[v.path] for v in _op.trainable_variables]
+                ntv = [w[v.path] for v in _op.non_trainable_variables]
+                out, _ = _op.stateless_call(tv, ntv, *args, **kwargs)
+                return out
+
+            return stateless
+
+        def weightless(*args, _op=op, **kwargs):
+            args = [
+                _slice_seq_at_position_matrix(a, pos_mat, maxlen)
+                for a in args
+            ]
+            kwargs = {
+                kk: _slice_seq_at_position_matrix(vv, pos_mat, maxlen)
+                for kk, vv in kwargs.items()
+            }
+            return _op(*args, **kwargs)
+
+        return weightless
+
+    logits = model._run_through_graph(tokens_chunk, operation_fn=handler)
+    return logits, {
+        name: ctx_new.get(name, caches[name]) for name in caches
+    }
+
+
+def prefix_copy(caches, src_idx, copy_mask, copy_len, maxlen):
+    """Slot-to-slot prefix transplant (ISSUE 4): destination slot ``d``
+    (where ``copy_mask[d]``) receives donor slot ``src_idx[d]``'s first
+    ``copy_len[d]`` K/V rows, for every layer — the device half of a
+    prefix-cache hit. The admitted request then prefills only its
+    un-cached suffix.
+
+    ONE compiled shape total: every argument is a fixed ``[num_slots]``
+    vector, so a wave with any mix of donors/destinations reuses the
+    same program. The donor gather is a one-hot contraction over the
+    slot axis — that axis is sharded over the mesh's batch axes, so
+    this DOES lower to a collective, but it runs once per admission
+    (outside the decode loop, where the same pattern was the measured
+    ~15× hazard).
+
+    Copied rows are bitwise what the destination's own prefill would
+    have produced: causal attention makes position ``i``'s K/V a
+    function of tokens ``0..i`` only, and the donor's rows were
+    computed from those exact tokens.
+
+    Returns the new caches dict."""
+    import jax.numpy as jnp
+
+    num_slots = src_idx.shape[0]
+    onehot_src = (
+        src_idx[:, None] == jnp.arange(num_slots)[None, :]
+    ) & copy_mask[:, None]  # [dst, src]
+    row_sel = (
+        copy_mask[:, None]
+        & (jnp.arange(maxlen)[None, :] < copy_len[:, None])
+    )[:, :, None, None]  # [dst, maxlen, 1, 1]
+    out = {}
+    for name, (ck, cv) in caches.items():
+        donor_k = jnp.einsum(
+            "ab,bmhd->amhd", onehot_src.astype(ck.dtype), ck
+        )
+        donor_v = jnp.einsum(
+            "ab,bmhd->amhd", onehot_src.astype(cv.dtype), cv
+        )
+        out[name] = (
+            jnp.where(row_sel, donor_k, ck),
+            jnp.where(row_sel, donor_v, cv),
+        )
+    return out

@@ -1,0 +1,244 @@
+"""Host-side radix index over cached prompt prefixes (ISSUE 4).
+
+Real serving fleets are dominated by requests sharing long prompt
+prefixes (system prompts, few-shot templates). Once a prompt has been
+prefilled into a KV slot, its first ``p`` arena rows are a reusable
+artifact: causal attention means the K/V of position ``i`` depends only
+on tokens ``0..i``, so ANY later prompt sharing those tokens can copy
+the rows instead of recomputing them. This module is the index that
+finds such donors.
+
+Design constraints, in order:
+
+- **Determinism.** Every gang process must compute the identical
+  schedule from the identical submission order (the SPMD contract the
+  scheduler already carries). So: no wall-clock anywhere — recency is a
+  logical clock bumped per cache operation; ties break on slot id.
+- **Slots are the unit of residence.** An entry maps one slot to the
+  token sequence whose K/V occupies its first ``length`` rows. The trie
+  gives longest-prefix lookup: each node holds the set of slots whose
+  cached sequence passes THROUGH it, so a lookup walks the prompt until
+  the path dies and takes the deepest node with a live slot.
+- **Refcounts guard the admission wave.** ``lookup`` pins the donor it
+  returns; an eviction scan skips pinned entries, so a donor chosen for
+  one admission cannot be evicted (and re-leased) by a later admission
+  in the same wave before the device copy has read it. Pins are
+  released by the scheduler once the wave's copies are issued.
+- **LRU eviction under slot pressure.** Donor slots (entries whose
+  request finished) are reclaimable: when the free list is empty the
+  scheduler evicts the least-recently-used unpinned, unleased entry and
+  hands its slot to the next admission.
+
+The cache never holds device memory itself — arena rows live in
+:class:`~elephas_tpu.serving.kv_cache.SlotKVCache`; this is pure
+bookkeeping about which rows are still meaningful.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Node:
+    children: dict = field(default_factory=dict)  # token -> _Node
+    slots: set = field(default_factory=set)  # slots covering this node
+
+
+@dataclass
+class CacheEntry:
+    """One resident prefix: ``slot``'s first ``length`` arena rows hold
+    the K/V of ``tokens``. ``leased`` while the prefilling request still
+    occupies the slot (the rows are stable — decode writes at positions
+    ``>= length`` — but the slot itself cannot be evicted); ``pins``
+    counts admission-wave references that block eviction."""
+
+    slot: int
+    tokens: tuple
+    length: int
+    last_use: int
+    leased: bool = True
+    pins: int = 0
+
+
+class PrefixCache:
+    """Radix index of cached prompt prefixes over KV slots.
+
+    All methods are O(len(tokens)) host work; nothing touches jax. The
+    scheduler owns one instance when ``prefix_cache=True`` and drives
+    it strictly from submission order.
+    """
+
+    def __init__(self):
+        self._root = _Node()
+        self._entries: dict[int, CacheEntry] = {}
+        self._clock = 0
+        # counters for stats()/bench — monotonic over the cache's life
+        self.hits = 0
+        self.misses = 0
+        self.reused_tokens = 0
+        self.evictions = 0
+
+    # -- registration ---------------------------------------------------
+
+    def insert(self, slot: int, tokens) -> None:
+        """Register ``slot`` as holding the K/V of ``tokens`` (called
+        when a request's prefill completes — the rows exist from that
+        moment on). Replaces any previous entry for the slot."""
+        if slot in self._entries:
+            self.remove(slot)
+        tokens = tuple(int(t) for t in tokens)
+        self._clock += 1
+        self._entries[slot] = CacheEntry(
+            slot=slot, tokens=tokens, length=len(tokens),
+            last_use=self._clock,
+        )
+        node = self._root
+        for t in tokens:
+            node = node.children.setdefault(t, _Node())
+            node.slots.add(slot)
+
+    def release(self, slot: int) -> bool:
+        """The occupying request finished: the entry survives as an
+        evictable donor. Returns True when the slot is retained (the
+        scheduler then keeps it OFF the free list)."""
+        entry = self._entries.get(slot)
+        if entry is None:
+            return False
+        entry.leased = False
+        return True
+
+    def remove(self, slot: int) -> None:
+        """Drop the slot's entry (it is being re-leased or evicted —
+        its rows are about to be overwritten)."""
+        entry = self._entries.pop(slot, None)
+        if entry is None:
+            return
+        node, path = self._root, []
+        for t in entry.tokens:
+            child = node.children.get(t)
+            if child is None:  # defensive: trie already pruned
+                break
+            path.append((node, t, child))
+            child.slots.discard(slot)
+            node = child
+        # prune now-empty suffix nodes so the trie does not grow
+        # unboundedly over the server's life
+        for parent, t, child in reversed(path):
+            if not child.slots and not child.children:
+                del parent.children[t]
+
+    # -- lookup / pinning ----------------------------------------------
+
+    def match(self, prompt, max_reuse: int | None = None):
+        """Longest cached prefix of ``prompt`` strictly shorter than
+        the prompt (at least one suffix token must remain to prefill —
+        the final position's logits are what admission samples from).
+
+        PURE — no counter, recency, or pin mutation: ``admit()`` probes
+        the queue head every step even when no slot is available, and a
+        blocked request must not inflate hit stats or bump its donor's
+        LRU rank once per step (that skewed eviction toward the blocked
+        request's donor and made the published hit counts wrong under
+        slot pressure). Callers :meth:`pin` the donor while they hold a
+        reference across eviction decisions, then :meth:`commit_hit`
+        (or :meth:`record_miss`) only when the admission really lands.
+
+        Returns ``(slot, reuse_len)`` or ``(None, 0)``."""
+        cap = len(prompt) - 1
+        if max_reuse is not None:
+            cap = min(cap, int(max_reuse))
+        node, depth = self._root, 0
+        best_depth, best_node = 0, None
+        for t in prompt:
+            if depth >= cap:
+                break
+            node = node.children.get(int(t))
+            if node is None or not node.slots:
+                break
+            depth += 1
+            best_depth, best_node = depth, node
+        if best_node is None:
+            return None, 0
+        # deterministic choice: most recently used, slot id breaking
+        # ties (every gang process computes the identical donor)
+        slot = max(
+            best_node.slots,
+            key=lambda s: (self._entries[s].last_use, -s),
+        )
+        return slot, best_depth
+
+    def pin(self, slot: int) -> None:
+        """Block eviction of the entry while a wave holds it."""
+        self._entries[slot].pins += 1
+
+    def unpin(self, slot: int) -> None:
+        entry = self._entries.get(slot)
+        if entry is not None and entry.pins > 0:
+            entry.pins -= 1
+
+    def commit_hit(self, slot: int, reuse_len: int) -> None:
+        """An admission actually reuses ``slot``'s rows: bump its
+        recency and the hit accounting."""
+        entry = self._entries.get(slot)
+        if entry is not None:
+            self._clock += 1
+            entry.last_use = self._clock
+        self.hits += 1
+        self.reused_tokens += int(reuse_len)
+
+    def record_miss(self) -> None:
+        """An admission landed with no reuse (no match, or the
+        cold-fallback path dropped its pinned donor)."""
+        self.misses += 1
+
+    def flush(self) -> list[int]:
+        """Drop EVERY entry (donors and leased alike) and return the
+        slots that were resident as unleased donors — the caller owns
+        putting those back on its free list. Used on weight refresh:
+        cached rows were computed under the old weights, and a donor
+        copy would silently splice stale K/V into a new-weights
+        request."""
+        donors = self.donor_slots
+        for slot in list(self._entries):
+            self.remove(slot)
+        return donors
+
+    # -- eviction -------------------------------------------------------
+
+    def evict_lru(self) -> int | None:
+        """Evict the least-recently-used unleased, unpinned entry and
+        return its (now free) slot — or None when nothing is evictable.
+        Ties break on slot id for gang determinism."""
+        victims = [
+            e for e in self._entries.values()
+            if not e.leased and e.pins == 0
+        ]
+        if not victims:
+            return None
+        victim = min(victims, key=lambda e: (e.last_use, e.slot))
+        self.remove(victim.slot)
+        self.evictions += 1
+        return victim.slot
+
+    # -- introspection --------------------------------------------------
+
+    @property
+    def donor_slots(self) -> list[int]:
+        """Slots resident as unleased donors (sorted, deterministic)."""
+        return sorted(
+            s for s, e in self._entries.items() if not e.leased
+        )
+
+    def entry(self, slot: int) -> CacheEntry | None:
+        return self._entries.get(slot)
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "donors": len(self.donor_slots),
+            "hits": self.hits,
+            "misses": self.misses,
+            "reused_tokens": self.reused_tokens,
+            "evictions": self.evictions,
+        }

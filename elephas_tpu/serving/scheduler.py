@@ -29,6 +29,8 @@ import itertools
 from collections import deque
 from dataclasses import dataclass, field
 
+from elephas_tpu.serving.prefix_cache import PrefixCache
+
 
 def default_buckets(max_len: int, floor: int = 16) -> tuple[int, ...]:
     """Power-of-two prompt buckets ``[floor, 2·floor, ..]`` capped at
@@ -79,22 +81,60 @@ class Request:
     finish_time: float | None = None
     on_token: object | None = None
     error: BaseException | None = None
+    # latency accounting (ISSUE 4): host arrival time of each generated
+    # token — token_times[0] - submit_time is the request's TTFT, the
+    # consecutive deltas its inter-token latencies
+    token_times: list = field(default_factory=list)
+    # prompt tokens served from the prefix cache instead of prefill
+    reused_tokens: int = 0
 
     @property
     def full_sequence(self) -> list:
         return list(self.prompt) + self.tokens
 
+    @property
+    def ttft(self) -> float | None:
+        """Submit→first-token seconds (None until the first token)."""
+        if not self.token_times or self.submit_time is None:
+            return None
+        return self.token_times[0] - self.submit_time
+
+    @property
+    def inter_token_times(self) -> list:
+        """Deltas between consecutive token arrivals (seconds)."""
+        tt = self.token_times
+        return [b - a for a, b in zip(tt, tt[1:])]
+
+
+@dataclass
+class Admission:
+    """One admission decision: ``req`` leases ``slot``; when the prefix
+    cache found a donor, ``donor_slot``'s first ``reuse_len`` arena
+    rows are copied before the (suffix-only) prefill."""
+
+    req: Request
+    slot: int
+    donor_slot: int | None = None
+    reuse_len: int = 0
+
 
 class Scheduler:
     """FIFO queue + slot lease tracking for :class:`InferenceEngine`."""
 
-    def __init__(self, num_slots: int, buckets):
+    def __init__(self, num_slots: int, buckets, prefix_cache: bool = False,
+                 prefix_min_reuse: int = 1):
         self.num_slots = int(num_slots)
         self.buckets = tuple(sorted(int(b) for b in buckets))
         self.waiting: deque[Request] = deque()
         self.active: dict[int, Request] = {}
         self._free: list[int] = list(range(self.num_slots))
         self._ids = itertools.count()
+        self.prefix_cache = PrefixCache() if prefix_cache else None
+        # matches shallower than this admit COLD: a 1-2 token
+        # coincidental prefix is not worth a copy dispatch (and on
+        # accidental-hit traffic would drag every admission through
+        # the donor path)
+        self.prefix_min_reuse = max(1, int(prefix_min_reuse))
         # occupancy accounting for the serving bench
         self._steps = 0
         self._busy_slot_steps = 0
@@ -119,18 +159,84 @@ class Scheduler:
 
     # -- per-step decisions --------------------------------------------
 
-    def admit(self) -> list[Request]:
-        """Lease free slots to waiting requests (FIFO), lowest slot
-        first — deterministic for the SPMD contract. Returns the newly
-        admitted requests (their ``slot`` set); the engine prefills
-        each."""
-        admitted = []
-        while self.waiting and self._free:
-            req = self.waiting.popleft()
-            req.slot = self._free.pop(0)
-            self.active[req.slot] = req
-            admitted.append(req)
+    def admit(self) -> list[Admission]:
+        """Lease slots to waiting requests (FIFO), lowest free slot
+        first, evicting LRU prefix-cache donors under slot pressure —
+        all deterministic for the SPMD contract. Returns the wave's
+        :class:`Admission` plan (donor + reuse length resolved per
+        request); the engine executes the copies and prefills.
+
+        Donor pinning: a donor chosen for one admission is pinned so a
+        LATER admission in the same wave cannot evict (and overwrite)
+        it before the engine's copy program has read it. When the only
+        evictable slot IS the pinned donor, reuse is dropped for that
+        request (admitted cold into the evicted donor) — admission
+        progress beats prefix reuse, and stalling here would livelock a
+        one-slot engine whose sole donor matches the queue head."""
+        admitted: list[Admission] = []
+        pinned: list[int] = []
+        cache = self.prefix_cache
+        while self.waiting:
+            req = self.waiting[0]
+            donor, reuse = (None, 0)
+            if cache is not None:
+                # match() is PURE; hit/LRU accounting commits only if
+                # the admission lands (a blocked queue head is probed
+                # every step and must not skew stats or eviction order)
+                donor, reuse = cache.match(req.prompt)
+                if donor is not None and reuse < self.prefix_min_reuse:
+                    donor, reuse = None, 0  # too shallow to pay a copy
+                if donor is not None:
+                    cache.pin(donor)
+                    pinned.append(donor)
+            if self._free:
+                slot = self._free.pop(0)
+            else:
+                slot = cache.evict_lru() if cache is not None else None
+                if slot is None and donor is not None:
+                    # the pinned donor may be the only evictable slot:
+                    # fall back to a cold admission
+                    cache.unpin(donor)
+                    pinned.pop()
+                    donor, reuse = None, 0
+                    slot = cache.evict_lru()
+                if slot is None:
+                    break  # genuinely full — request keeps waiting
+            self.waiting.popleft()
+            if cache is not None:
+                cache.remove(slot)  # rows are about to be overwritten
+                if donor is not None:
+                    cache.commit_hit(donor, reuse)
+                else:
+                    cache.record_miss()
+            req.slot = slot
+            req.reused_tokens = reuse
+            self.active[slot] = req
+            admitted.append(
+                Admission(req=req, slot=slot, donor_slot=donor,
+                          reuse_len=reuse)
+            )
+        # the engine copies donor rows synchronously right after this
+        # wave returns and nothing can evict before the next admit()
+        # call, so wave-scoped pins release here
+        if cache is not None:
+            for slot in pinned:
+                cache.unpin(slot)
         return admitted
+
+    def on_prefill_complete(self, req: Request) -> None:
+        """Register the request's prompt rows as a reusable prefix (its
+        slot's first ``len(prompt)`` rows now hold that K/V)."""
+        if self.prefix_cache is not None and req.slot is not None:
+            self.prefix_cache.insert(req.slot, req.prompt)
+
+    def flush_prefix_cache(self) -> None:
+        """Invalidate every cached prefix and return donor slots to the
+        free list (weight refresh: resident rows are stale)."""
+        if self.prefix_cache is None:
+            return
+        self._free.extend(self.prefix_cache.flush())
+        self._free.sort()
 
     def on_token(self, slot: int, token: int) -> bool:
         """Record one generated token for the slot's occupant; returns
@@ -147,9 +253,13 @@ class Scheduler:
 
     def reclaim(self, slot: int) -> Request:
         """Free the slot immediately — the next :meth:`admit` can hand
-        it to a waiting request in the same engine step."""
+        it to a waiting request in the same engine step. With the
+        prefix cache on, a slot whose prompt rows are indexed is
+        RETAINED as a donor instead (evicted LRU under pressure)."""
         req = self.active.pop(slot)
         req.slot = None
+        if self.prefix_cache is not None and self.prefix_cache.release(slot):
+            return req  # resident donor — off the free list
         self._free.append(slot)
         self._free.sort()
         return req

@@ -1035,6 +1035,11 @@ class SparkModel:
         top_p: float | None = None,
         seed: int = 0,
         buckets=None,
+        steps_per_sync: int = 1,
+        prefix_cache: bool = False,
+        prefix_min_reuse: int = 1,
+        prefill_chunk: int | None = None,
+        prefill_budget: int | None = None,
     ):
         """A continuous-batching :class:`~elephas_tpu.serving.engine.\
 InferenceEngine` over this wrapper's mesh — the serving analogue of
@@ -1074,6 +1079,11 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
             top_p=top_p,
             seed=seed,
             buckets=buckets,
+            steps_per_sync=steps_per_sync,
+            prefix_cache=prefix_cache,
+            prefix_min_reuse=prefix_min_reuse,
+            prefill_chunk=prefill_chunk,
+            prefill_budget=prefill_budget,
         )
 
     # -- persistence ---------------------------------------------------

@@ -84,6 +84,29 @@ def spark_context():
 
 
 @pytest.fixture(scope="session")
+def serving_lm():
+    """A small trained LM (periodic sequences, as in test_mesh_generate)
+    shared by the serving suites — training sharpens the logits so
+    greedy parity across shardings is not a coin flip, and training it
+    ONCE keeps tier-1 inside its wall-clock budget (test_serving and
+    test_serving_prefix used to each pay the ~30s fit)."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import transformer_lm
+
+    maxlen, vocab, n = 32, 8, 256
+    rng = np.random.default_rng(0)
+    starts = rng.integers(2, 6, size=n)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+    m = transformer_lm(
+        vocab_size=vocab, maxlen=maxlen, d_model=32, num_heads=2,
+        num_layers=2, dropout=0.0, lr=1e-2, seed=0,
+    )
+    SparkModel(m, num_workers=4).fit((x, y), epochs=4, batch_size=32)
+    return m
+
+
+@pytest.fixture(scope="session")
 def blobs():
     """Separable 3-class gaussian blobs — the MNIST stand-in (no network
     access for real dataset downloads; end-task-quality assertions follow

@@ -180,10 +180,13 @@ class TestBenchPsContract:
 
 
 class TestFaultPathLint:
-    """ISSUE 3 satellite: the fault/recovery paths must never swallow
-    failures. A bare ``except:`` anywhere, or an ``except
-    [Base]Exception:`` whose body is only ``pass``, in the PS wire
-    modules or the chaos harness fails this grep-lint — unless the line
+    """ISSUE 3 satellite (extended to the serving vertical in ISSUE 4):
+    the fault/recovery paths — and the serving engine, whose slot/
+    prefix-cache bookkeeping corrupts silently if an error is eaten
+    mid-step — must never swallow failures. A bare ``except:``
+    anywhere, or an ``except [Base]Exception:`` whose body is only
+    ``pass``, in the PS wire modules, the chaos harness, or
+    ``elephas_tpu/serving/`` fails this grep-lint — unless the line
     carries an explicit ``fault-lint: allow`` tag with a reason
     (narrow handlers like ``except OSError`` around close() paths stay
     allowed; it is the catch-everything-and-ignore shape that hides
@@ -198,13 +201,13 @@ class TestFaultPathLint:
     def _fault_path_files():
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         files = [os.path.join(root, "elephas_tpu", "utils", "sockets.py")]
-        for pkg in ("parameter", "fault"):
+        for pkg in ("parameter", "fault", "serving"):
             files.extend(
                 sorted(glob.glob(
                     os.path.join(root, "elephas_tpu", pkg, "*.py")
                 ))
             )
-        assert len(files) > 5  # the glob must actually find the modules
+        assert len(files) > 9  # the glob must actually find the modules
         return root, files
 
     def test_no_bare_or_swallowed_excepts_on_fault_paths(self):

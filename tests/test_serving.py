@@ -15,24 +15,9 @@ import pytest
 
 
 @pytest.fixture(scope="module")
-def lm():
-    """A small trained LM (periodic sequences, as in
-    test_mesh_generate) — training sharpens the logits so greedy
-    parity across shardings is not a coin flip."""
-    from elephas_tpu import SparkModel
-    from elephas_tpu.models import transformer_lm
-
-    maxlen, vocab, n = 32, 8, 256
-    rng = np.random.default_rng(0)
-    starts = rng.integers(2, 6, size=n)
-    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
-    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
-    m = transformer_lm(
-        vocab_size=vocab, maxlen=maxlen, d_model=32, num_heads=2,
-        num_layers=2, dropout=0.0, lr=1e-2, seed=0,
-    )
-    SparkModel(m, num_workers=4).fit((x, y), epochs=4, batch_size=32)
-    return m
+def lm(serving_lm):
+    """The session-trained serving LM (see conftest.serving_lm)."""
+    return serving_lm
 
 
 MIXED_PROMPTS = [
@@ -319,14 +304,17 @@ def test_scheduler_bookkeeping():
     reqs = [
         s.submit(s.make_request([1, 2], 3)) for _ in range(3)
     ]
-    admitted = s.admit()
-    assert [r.slot for r in admitted] == [0, 1]
+    admitted = s.admit()  # Admission plans (ISSUE 4)
+    assert [a.req for a in admitted] == reqs[:2]
+    assert [a.slot for a in admitted] == [0, 1]
+    assert [a.donor_slot for a in admitted] == [None, None]  # cache off
     assert s.admit() == []  # full
     assert not s.on_token(0, 9)  # 1/3 tokens
     assert not s.on_token(0, 9)
     assert s.on_token(0, 9)  # budget reached
     s.reclaim(0)
-    assert s.admit()[0] is reqs[2] and reqs[2].slot == 0
+    nxt = s.admit()[0]
+    assert nxt.req is reqs[2] and reqs[2].slot == 0
     s.note_step()
     assert s.occupancy == 1.0  # both slots busy on the counted step
 
