@@ -28,10 +28,39 @@ import time
 
 import numpy as np
 
+from elephas_tpu import telemetry
 from elephas_tpu.fault.plan import FaultPlan
 from elephas_tpu.utils import sockets
 
 logger = logging.getLogger(__name__)
+
+
+def _require_telemetry(what: str) -> None:
+    """The chaos machinery reads registry-backed counters for its kill
+    trigger and recovery stamps (``updates_applied`` polling) — under
+    telemetry null mode those read 0 and the killer would never fire.
+    Refuse loudly instead of hanging."""
+    if telemetry.null_mode():
+        raise RuntimeError(
+            f"{what} requires telemetry: the kill trigger and recovery "
+            f"detection poll registry-backed counters, which read 0 "
+            f"under null mode — call telemetry.set_null(False) first"
+        )
+
+
+def recovery_windows_from_trace(tracer=None, since_seq: int = 0) -> list:
+    """Kill→first-post-restart-apply windows (seconds) read from the
+    trace stream — the ``chaos.recovery`` spans :class:`PSKiller`
+    records, filtered to those that actually observed recovery. This is
+    what ``bench.py --preset faults`` reports (ISSUE 5 satellite: the
+    bench reads the same stream an operator's trace viewer shows, not
+    bespoke harness counters)."""
+    tracer = tracer or telemetry.tracer()
+    return [
+        float(e["dur"])
+        for e in tracer.events(since_seq=since_seq, name="chaos.recovery")
+        if e["args"].get("recovered")
+    ]
 
 
 class RestartablePS:
@@ -52,6 +81,7 @@ class RestartablePS:
         journal_every: int = 2,
         lease_timeout: float = 30.0,
     ):
+        _require_telemetry("RestartablePS")
         self._server_cls = server_cls
         self._weights = [np.asarray(w) for w in weights]
         self._mode = mode
@@ -90,6 +120,7 @@ class RestartablePS:
             return
         self.t_killed = time.monotonic()
         self.kills += 1
+        telemetry.emit("chaos.ps_kill", port=self.port, kills=self.kills)
         server.stop(flush_journal=False)
         # absorb AFTER stop: an op in flight at the kill may still
         # complete its apply while connections sever
@@ -101,6 +132,10 @@ class RestartablePS:
         server.start()
         self.server = server
         self.restarts += 1
+        telemetry.emit(
+            "chaos.ps_restart", port=self.port,
+            journal_restored=server.restored_from_journal,
+        )
         logger.info(
             "chaos: parameter server restarted on port %d (journal "
             "restored: %s)", self.port, server.restored_from_journal,
@@ -167,10 +202,22 @@ class PSKiller(threading.Thread):
     def run(self) -> None:
         if not self._wait_for_updates(self.baseline + self.after_updates):
             return
-        self.ps.kill()
-        time.sleep(self.restart_delay_s)
-        self.ps.restart()
-        if self._wait_for_updates(1):
+        # the kill→first-post-restart-apply window is ONE span on the
+        # shared trace timeline (ISSUE 5): the bench and tests read the
+        # recovery number from the same stream an operator's trace
+        # viewer shows. `recovered` is stamped on the span so a
+        # cancelled run never masquerades as a measured recovery.
+        with telemetry.trace_span(
+            "chaos.recovery", port=self.ps.port,
+            after_updates=self.after_updates,
+            restart_delay_s=self.restart_delay_s,
+        ) as span:
+            self.ps.kill()
+            time.sleep(self.restart_delay_s)
+            self.ps.restart()
+            recovered = self._wait_for_updates(1)
+            span.set(recovered=recovered)
+        if recovered:
             self.ps.t_recovered = time.monotonic()
 
 
@@ -214,6 +261,7 @@ def run_chaos_training(
     journal_every: int = 2,
     mode: str = "asynchronous",
     ps_retries: int = 8,
+    trace_export: str | None = None,
 ) -> dict:
     """One real async-worker training run under ``plan`` (or fault-free
     when ``plan`` is None) against a restartable, journaled PS.
@@ -222,11 +270,17 @@ def run_chaos_training(
     timed (post-warmup) window, kill/restart/recovery timestamps,
     applied/duplicate counts aggregated across server incarnations, and
     the worker clients' lost/resent counters — plus the final server
-    weights so callers can evaluate convergence.
+    weights so callers can evaluate convergence. ``recovery_s_trace``
+    is the kill→recovery window read from the trace stream (the
+    ``chaos.recovery`` span), and ``trace_export`` dumps this run's
+    events as Chrome-trace JSON — the kill, restart, recovery span,
+    worker retries, and PS round-trips on one timeline.
     """
     from elephas_tpu.parameter.server import HttpServer, SocketServer
     from elephas_tpu.worker import AsynchronousSparkWorker
 
+    _require_telemetry("run_chaos_training")
+    trace_seq0 = telemetry.tracer().seq
     x, y, d, k = _chaos_data(seed, rows)
     model = _chaos_model(seed, d, k)
     server_cls = {"socket": SocketServer, "http": HttpServer}[transport]
@@ -298,6 +352,15 @@ def run_chaos_training(
     finally:
         ps.stop()
 
+    trace_windows = recovery_windows_from_trace(since_seq=trace_seq0)
+    if trace_export:
+        n_events = telemetry.tracer().export_chrome_trace(
+            trace_export, since_seq=trace_seq0
+        )
+        logger.info(
+            "chaos trace: %d events exported to %s", n_events, trace_export
+        )
+
     return {
         "transport": transport,
         "rows": rows,
@@ -305,6 +368,10 @@ def run_chaos_training(
         "seed": seed,
         "dt_s": dt,
         "samples_per_s": rows * epochs / dt,
+        # kill→recovery read from the trace stream (ISSUE 5): the
+        # number the bench reports, sourced from the same events an
+        # operator's trace viewer shows
+        "recovery_s_trace": trace_windows[-1] if trace_windows else None,
         "updates_applied": counters["updates_applied"] - baseline_updates,
         "duplicates_skipped": counters["updates_duplicate"],
         "updates_resent": sum(c.updates_resent for c in clients),
@@ -332,6 +399,7 @@ def measure_faults(
     kill_after_updates: int | None = None,
     restart_delay_s: float = 0.75,
     duplicate_fraction: float = 0.25,
+    trace_export: str | None = None,
 ):
     """``bench.py --preset faults`` backend: one fault-free run and one
     chaos run (PS kill+restart mid-epoch, a seeded fraction of update
@@ -364,5 +432,6 @@ def measure_faults(
             seed=seed,
             plan=plan,
             journal_dir=jdir,
+            trace_export=trace_export,
         )
     return clean, faulted, plan

@@ -111,6 +111,16 @@ class FaultPlan:
         faults = self.socket_faults
         if faults is None:
             return None
+        from elephas_tpu import telemetry
+
+        injected = telemetry.registry().counter(
+            "elephas_chaos_wire_faults_total",
+            "Wire faults injected by the active chaos plan, by kind",
+            labels=("kind",),
+        )
+        m_drop = injected.labels(kind="drop")
+        m_sever = injected.labels(kind="sever")
+        m_delay = injected.labels(kind="delay")
         lock = threading.Lock()
         state = {"n": 0, "severed_until": None}
 
@@ -126,15 +136,26 @@ class FaultPlan:
                     state["severed_until"] = (
                         time.monotonic() + faults.sever_for_s
                     )
+                    # the window OPENING is the interesting timeline
+                    # event; per-op failures inside it would flood the
+                    # ring without adding information
+                    telemetry.emit(
+                        "chaos.wire_severed", op=op,
+                        for_s=faults.sever_for_s,
+                    )
                 severed_until = state["severed_until"]
             if severed_until is not None and time.monotonic() < severed_until:
+                m_sever.inc()
                 raise ConnectionError(
                     f"chaos: network severed ({op} inside the partition "
                     f"window)"
                 )
             if faults.delay_every and n % faults.delay_every == 0:
+                m_delay.inc()
                 time.sleep(faults.delay_ms / 1e3)
             if faults.drop_every and n % faults.drop_every == 0:
+                m_drop.inc()
+                telemetry.emit("chaos.wire_drop", op=op, n=n)
                 raise ConnectionError(f"chaos: injected {op} drop (op {n})")
 
         return hook

@@ -42,6 +42,7 @@ import struct
 import uuid
 from collections import deque
 
+from elephas_tpu import telemetry
 from elephas_tpu.parameter import codec as wire
 from elephas_tpu.utils import sockets
 
@@ -108,11 +109,8 @@ class BaseParameterClient:
         )
         self._binary: bool | None = None  # None until negotiated
         self.client_id = client_id or default_client_id()
-        self._seq = 0  # next sequence ID to assign (monotonic)
-        self.bytes_sent = 0
-        self.bytes_received = 0
-        self.updates_resent = 0  # unacked pushes safely replayed
-        self.updates_duplicate = 0  # resends the server dedup-skipped
+        self._seq = 0  # next sequence ID to assign (monotonic, PLAIN —
+        # it drives the dedup protocol, so it must never ride telemetry)
         # chaos-injection hook (elephas_tpu.fault): when set, called as
         # hook(seq) after a successful sequenced push; returning True
         # makes the client resend the identical frame — the harness's
@@ -120,14 +118,86 @@ class BaseParameterClient:
         self.chaos_duplicate = None
         self.chaos_dups_sent = 0
 
+        # -- telemetry (ISSUE 5): wire counters live in the registry;
+        # the same-named attributes below are read-back views, so the
+        # bench's bytes-per-sync and a Prometheus scrape can never
+        # disagree. Labeled by a process-monotonic instance id, not
+        # client_id (which embeds a uuid — scrapes should be stable
+        # across identically-driven gang processes).
+        reg = telemetry.registry()
+        label = telemetry.instance_label()
+        self.telemetry_label = label
+        self._tracer = telemetry.tracer()
+
+        def _c(name, help_):
+            return reg.counter(
+                name, help_, labels=("client",)
+            ).labels(client=label)
+
+        self._m_bytes_sent = _c(
+            "elephas_ps_client_bytes_sent_total",
+            "Payload bytes pushed to the parameter server",
+        )
+        self._m_bytes_received = _c(
+            "elephas_ps_client_bytes_received_total",
+            "Payload bytes pulled from the parameter server",
+        )
+        self._m_updates_resent = _c(
+            "elephas_ps_client_updates_resent_total",
+            "Unacked pushes safely replayed after a reconnect",
+        )
+        self._m_updates_duplicate = _c(
+            "elephas_ps_client_updates_duplicate_total",
+            "Pushes the server dedup-skipped as already applied",
+        )
+        self._m_updates_lost = reg.gauge(
+            "elephas_ps_client_updates_lost",
+            "Pushes in doubt on a dead connection (drains as resends "
+            "are acked)",
+            labels=("client",),
+        ).labels(client=label)
+        # reset_counters() baselines (counters are monotonic)
+        self._bytes_sent_base = 0
+        self._bytes_received_base = 0
+
     def _next_seq(self) -> int:
         seq = self._seq
         self._seq += 1
         return seq
 
+    # -- telemetry views (ISSUE 5 satellite) ---------------------------
+
+    @property
+    def bytes_sent(self) -> int:
+        return int(self._m_bytes_sent.value) - self._bytes_sent_base
+
+    @property
+    def bytes_received(self) -> int:
+        return int(self._m_bytes_received.value) - self._bytes_received_base
+
+    @property
+    def updates_resent(self) -> int:
+        return int(self._m_updates_resent.value)
+
+    @property
+    def updates_duplicate(self) -> int:
+        return int(self._m_updates_duplicate.value)
+
     def reset_counters(self) -> None:
-        self.bytes_sent = 0
-        self.bytes_received = 0
+        """Re-baseline the byte VIEWS (``bytes_sent``/``bytes_received``
+        read as 0 from here). The underlying registry counters stay
+        monotonic, as Prometheus counters must."""
+        self._bytes_sent_base = int(self._m_bytes_sent.value)
+        self._bytes_received_base = int(self._m_bytes_received.value)
+
+    def release_telemetry(self) -> None:
+        """Retire this client's labeled series from the process
+        registry. NOT called by ``close()``: scraping after teardown is
+        a supported shape, so retirement is the host's explicit call —
+        long-lived processes that churn clients (one per partition per
+        fit) call this to keep scrape output bounded. The object-held
+        views (``bytes_sent`` etc.) keep reading their own series."""
+        telemetry.remove_series(client=self.telemetry_label)
 
     def _encode_update(self, delta) -> bytes:
         """Encode ONCE per update — the error-feedback residual mutates
@@ -201,12 +271,12 @@ class HttpClient(BaseParameterClient):
                     raise ConnectionError("server closed mid-frame")
                 chunks.append(chunk)
                 got += len(chunk)
-            self.bytes_received += n
+            self._m_bytes_received.inc(n)
             return b"".join(chunks)
 
         def readinto(mv: memoryview) -> int:
             got = resp.readinto(mv)
-            self.bytes_received += got or 0
+            self._m_bytes_received.inc(got or 0)
             return got
 
         return read_exact, readinto
@@ -214,7 +284,8 @@ class HttpClient(BaseParameterClient):
     # -- protocol ------------------------------------------------------
 
     def get_parameters(self):
-        return self._retry(self._get_once)
+        with self._tracer.span("ps.pull", client=self.telemetry_label):
+            return self._retry(self._get_once)
 
     def _get_once(self):
         if self._binary is not False:
@@ -240,7 +311,7 @@ class HttpClient(BaseParameterClient):
             resp.read()
             raise ConnectionError(f"GET /parameters -> {resp.status}")
         payload = resp.read()
-        self.bytes_received += len(payload)
+        self._m_bytes_received.inc(len(payload))
         return pickle.loads(payload)  # legacy-pickle fallback path
 
     def update_parameters(self, delta) -> None:
@@ -250,27 +321,30 @@ class HttpClient(BaseParameterClient):
         skipped server-side) — effectively-once end to end. Against a
         pre-ISSUE-3 binary server the headers are ignored and the old
         double-apply caveat stands."""
-        if self._binary is False and self._feedback is None:
-            # known-legacy server + lossless push: pickle the delta
-            # directly, skipping a pointless codec encode+decode pass
-            self._retry(lambda: self._legacy_update(pickle.dumps(delta)))
-            return
-        body = self._encode_update(delta)
-        seq = self._next_seq()
-        self._retry(lambda: self._update_once(body, seq))
+        with self._tracer.span("ps.push", client=self.telemetry_label):
+            if self._binary is False and self._feedback is None:
+                # known-legacy server + lossless push: pickle the delta
+                # directly, skipping a pointless codec encode+decode pass
+                self._retry(
+                    lambda: self._legacy_update(pickle.dumps(delta))
+                )
+                return
+            body = self._encode_update(delta)
+            seq = self._next_seq()
+            self._retry(lambda: self._update_once(body, seq))
 
     def _update_once(self, body: bytes, seq: int | None = None) -> None:
         if self._binary is not False:
             applied = self._post_update_bin(body, seq)
             if applied is not None:
                 if not applied:
-                    self.updates_duplicate += 1
+                    self._m_updates_duplicate.inc()
                 elif self.chaos_duplicate is not None and seq is not None \
                         and self.chaos_duplicate(seq):
                     # chaos harness: wire-level duplicate of this frame
                     self.chaos_dups_sent += 1
                     if self._post_update_bin(body, seq) is False:
-                        self.updates_duplicate += 1
+                        self._m_updates_duplicate.inc()
                 return
             self._binary = False
         # Legacy server: ship the delta AS THE SERVER WILL SEE IT — the
@@ -291,7 +365,7 @@ class HttpClient(BaseParameterClient):
         resp.read()
         if resp.status == 200:
             self._binary = True
-            self.bytes_sent += len(body)
+            self._m_bytes_sent.inc(len(body))
             return resp.getheader("X-Elephas-Applied", "1") != "0"
         if resp.status != 404:
             raise ConnectionError(f"POST /update.bin -> {resp.status}")
@@ -309,7 +383,7 @@ class HttpClient(BaseParameterClient):
         resp.read()
         if resp.status != 200:
             raise ConnectionError(f"POST /update -> {resp.status}")
-        self.bytes_sent += len(payload)
+        self._m_bytes_sent.inc(len(payload))
 
     # -- liveness (ISSUE 3) -------------------------------------------
 
@@ -379,8 +453,14 @@ class SocketClient(BaseParameterClient):
         # made safe by the server-side dedup
         self._unacked: deque[tuple[int | None, bytes | None]] = deque()
         self._resend: deque[tuple[int, bytes]] = deque()
-        self.updates_lost = 0  # unacked pushes in doubt on a dead conn
         self._connect()
+
+    @property
+    def updates_lost(self) -> int:
+        """Unacked pushes in doubt on a dead conn — a registry GAUGE
+        (it drains back down as resends ack), read-back view like the
+        counters."""
+        return int(self._m_updates_lost.value)
 
     @property
     def _sequenced(self) -> bool:
@@ -427,7 +507,7 @@ class SocketClient(BaseParameterClient):
             if overflow:
                 resendable = resendable[overflow:]
             self._resend.extend(resendable)
-            self.updates_lost += len(self._unacked)
+            self._m_updates_lost.inc(len(self._unacked))
             logger.warning(
                 "connection lost with %d unacked update(s); %d queued "
                 "for sequence-deduplicated resend, %d unrecoverable "
@@ -460,11 +540,11 @@ class SocketClient(BaseParameterClient):
             if ack not in (b"k", b"d"):
                 raise ConnectionError(f"bad resend ack {ack!r}")
             self._resend.popleft()
-            self.updates_lost = max(0, self.updates_lost - 1)
-            self.updates_resent += 1
+            self._m_updates_lost.set(max(0, self.updates_lost - 1))
+            self._m_updates_resent.inc()
             if ack == b"d":
-                self.updates_duplicate += 1
-            self.bytes_sent += len(body)
+                self._m_updates_duplicate.inc()
+            self._m_bytes_sent.inc(len(body))
 
     def _drain_acks(self) -> None:
         """Collect outstanding update acks. Pushes are PIPELINED — the
@@ -476,7 +556,7 @@ class SocketClient(BaseParameterClient):
             ack = sockets.read_exact(self._sock, 1)
             seq, _body = self._unacked.popleft()
             if ack == b"d":
-                self.updates_duplicate += 1
+                self._m_updates_duplicate.inc()
             elif ack != b"k":
                 raise ConnectionError(f"bad update ack {ack!r}")
 
@@ -499,12 +579,12 @@ class SocketClient(BaseParameterClient):
 
         def read_exact(n: int) -> bytes:
             buf = read(n)
-            self.bytes_received += n
+            self._m_bytes_received.inc(n)
             return buf
 
         def readinto(mv: memoryview) -> int:
             got = recv_into(mv)
-            self.bytes_received += got or 0
+            self._m_bytes_received.inc(got or 0)
             return got
 
         return read_exact, readinto
@@ -512,7 +592,8 @@ class SocketClient(BaseParameterClient):
     # -- protocol ------------------------------------------------------
 
     def get_parameters(self):
-        return self._retry(self._get_once)
+        with self._tracer.span("ps.pull", client=self.telemetry_label):
+            return self._retry(self._get_once)
 
     def _get_once(self):
         self._ensure_sock()
@@ -527,7 +608,7 @@ class SocketClient(BaseParameterClient):
         out, nbytes = sockets.receive_with_size(self._sock)
         if out is None:
             raise ConnectionError("server closed during get")
-        self.bytes_received += nbytes
+        self._m_bytes_received.inc(nbytes)
         return out
 
     def update_parameters(self, delta) -> None:
@@ -537,12 +618,13 @@ class SocketClient(BaseParameterClient):
         version-1 server the old at-least-once caveat stands (a resend
         can double-apply), and a push whose connection dies before its
         pipelined ack is counted in ``updates_lost`` without resend."""
-        if self._binary:
-            body = self._encode_update(delta)  # once: feedback mutates
-            seq = self._next_seq() if self._sequenced else None
-            self._retry(lambda: self._push_once(seq, body))
-        else:
-            self._retry(lambda: self._push_pickle(delta))
+        with self._tracer.span("ps.push", client=self.telemetry_label):
+            if self._binary:
+                body = self._encode_update(delta)  # once: feedback mutates
+                seq = self._next_seq() if self._sequenced else None
+                self._retry(lambda: self._push_once(seq, body))
+            else:
+                self._retry(lambda: self._push_pickle(delta))
 
     def _push_once(self, seq: int | None, body: bytes) -> None:
         self._ensure_sock()
@@ -554,7 +636,7 @@ class SocketClient(BaseParameterClient):
         else:
             self._sock.sendall(b"U" + body)
             self._unacked.append((None, None))
-        self.bytes_sent += len(body)
+        self._m_bytes_sent.inc(len(body))
         if seq is not None and self.chaos_duplicate is not None \
                 and self.chaos_duplicate(seq):
             # chaos harness: duplicate the identical frame on the wire
@@ -567,7 +649,7 @@ class SocketClient(BaseParameterClient):
         self._ensure_sock()
         self._sock.sendall(b"u")
         # legacy-pickle fallback path
-        self.bytes_sent += sockets.send(self._sock, delta)
+        self._m_bytes_sent.inc(sockets.send(self._sock, delta))
 
     # -- liveness (ISSUE 3) -------------------------------------------
 
@@ -637,7 +719,7 @@ class SocketClient(BaseParameterClient):
             # silently (callers that need certainty call flush() first)
             in_doubt = len(self._unacked) + len(self._resend)
             if in_doubt:
-                self.updates_lost += len(self._unacked)
+                self._m_updates_lost.inc(len(self._unacked))
                 logger.warning(
                     "close() with %d unconfirmed update(s) on a dead "
                     "connection (%r) — call flush() before close() for "

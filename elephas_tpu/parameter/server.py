@@ -61,10 +61,12 @@ import socketserver
 import struct
 import threading
 import time
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from elephas_tpu import telemetry
 from elephas_tpu.parameter import codec as wire
 from elephas_tpu.parameter import journal as journal_io
 from elephas_tpu.utils import sockets
@@ -78,6 +80,19 @@ PROTOCOL_VERSION = 2
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
+
+
+def _weak_gauge_fn(obj, method):
+    """A pull-time gauge callback that does not pin ``obj`` (servers
+    come and go in one process; the registry must not keep dead ones —
+    and their weight lists — alive). NaN once the server is gone."""
+    ref = weakref.ref(obj)
+
+    def call():
+        server = ref()
+        return float("nan") if server is None else method(server)
+
+    return call
 
 
 class BaseParameterServer:
@@ -116,14 +131,71 @@ class BaseParameterServer:
         self.lease_timeout = float(lease_timeout)
         self.seq_table: dict[str, int] = {}  # client id -> last applied seq
         self.leases: dict[str, float] = {}  # client id -> last heartbeat
-        self.updates_applied = 0
-        self.updates_duplicate = 0
-        self.journal_writes = 0
         self.restored_from_journal = False
         self._seq_lock = threading.Lock()
         self._journal_lock = threading.Lock()
-        self._last_journal_at = 0  # updates_applied at the last snapshot
+        # journal cadence runs on this PLAIN count, never the telemetry
+        # counter below — under telemetry null mode metrics read 0, and
+        # snapshot cadence is correctness, not reporting (ISSUE 5
+        # contract: telemetry never drives control flow)
+        self._applied_seen = 0
+        self._last_journal_at = 0  # _applied_seen at the last snapshot
         self._created_at = time.monotonic()
+
+        # -- telemetry (ISSUE 5): counters are the single store for the
+        # reported values; `updates_applied` etc. read them back
+        reg = telemetry.registry()
+        sid = telemetry.instance_label()
+        self.telemetry_label = sid
+        self._tracer = telemetry.tracer()
+
+        def _c(name, help_):
+            return reg.counter(
+                name, help_, labels=("server",)
+            ).labels(server=sid)
+
+        self._m_updates_applied = _c(
+            "elephas_ps_updates_applied_total",
+            "Weight deltas applied by the parameter server",
+        )
+        self._m_updates_duplicate = _c(
+            "elephas_ps_updates_duplicate_total",
+            "Sequenced updates skipped as already-applied duplicates",
+        )
+        self._m_journal_writes = _c(
+            "elephas_ps_journal_writes_total",
+            "Journal snapshots written (periodic + terminal)",
+        )
+        self._m_heartbeats = _c(
+            "elephas_ps_heartbeats_total",
+            "Worker lease refreshes received",
+        )
+        # pull-time gauges: lag/staleness change with time, not events
+        reg.gauge(
+            "elephas_ps_journal_lag_updates",
+            "Applied updates not yet covered by a journal snapshot",
+            labels=("server",),
+        ).labels(server=sid).set_function(_weak_gauge_fn(
+            self, lambda s: s._applied_seen - s._last_journal_at
+        ))
+        reg.gauge(
+            "elephas_ps_live_members",
+            "Workers whose lease is within lease_timeout",
+            labels=("server",),
+        ).labels(server=sid).set_function(_weak_gauge_fn(
+            self, lambda s: sum(
+                1 for m in s.members().values() if m["live"]
+            )
+        ))
+        reg.gauge(
+            "elephas_ps_oldest_heartbeat_age_seconds",
+            "Staleness of the least-recently-heard worker lease",
+            labels=("server",),
+        ).labels(server=sid).set_function(_weak_gauge_fn(
+            self, lambda s: max(
+                (m["age_s"] for m in s.members().values()), default=0.0
+            )
+        ))
         # live client connections: stdlib shutdown() only stops the
         # ACCEPT loop — established keep-alive connections would keep
         # being served by zombie handler threads after stop(), so a
@@ -169,6 +241,34 @@ class BaseParameterServer:
             journal_dir, len(seq_table), meta,
         )
 
+    # -- telemetry views (ISSUE 5) -------------------------------------
+    # The registry counters are the only store; these read them back so
+    # status(), /metrics, and the chaos harness can never drift apart.
+    # Under null mode they read 0 — the chaos harness (which polls
+    # `updates_applied` as its kill trigger) refuses to run there.
+
+    @property
+    def updates_applied(self) -> int:
+        return int(self._m_updates_applied.value)
+
+    @property
+    def updates_duplicate(self) -> int:
+        return int(self._m_updates_duplicate.value)
+
+    @property
+    def journal_writes(self) -> int:
+        return int(self._m_journal_writes.value)
+
+    def release_telemetry(self) -> None:
+        """Retire this server's labeled series (counters AND the
+        pull-time gauges) from the process registry. NOT called by
+        ``stop()``: a killed PS's final counters staying scrapeable is
+        part of the chaos-timeline contract — retirement is for hosts
+        that restart servers in a loop and want scrape output bounded.
+        The counter-backed properties above keep reading their own
+        series after retirement."""
+        telemetry.remove_series(server=self.telemetry_label)
+
     # -- weight store --------------------------------------------------
 
     def get_parameters(self) -> list[np.ndarray]:
@@ -197,7 +297,7 @@ class BaseParameterServer:
             return True
         with self._seq_lock:
             if seq <= self.seq_table.get(client_id, -1):
-                self.updates_duplicate += 1
+                self._m_updates_duplicate.inc()
                 return False
             self.update_parameters(delta)
             self.seq_table[client_id] = int(seq)
@@ -221,6 +321,7 @@ class BaseParameterServer:
         the first heartbeat or sequenced update creates it)."""
         with self._lease_lock:
             self.leases[client_id] = time.monotonic()
+        self._m_heartbeats.inc()
 
     def members(self) -> dict[str, dict]:
         """Known workers with lease staleness: ``{id: {age_s, live}}``.
@@ -295,10 +396,11 @@ class BaseParameterServer:
     # -- journaling (ISSUE 3) ------------------------------------------
 
     def _note_update(self) -> None:
+        self._m_updates_applied.inc()
         with self._seq_lock:  # concurrent clients: no lost increments
-            self.updates_applied += 1
+            self._applied_seen += 1
             due = bool(self.journal_dir) and (
-                self.updates_applied - self._last_journal_at
+                self._applied_seen - self._last_journal_at
                 >= self.journal_every
             )
         if due:  # outside _seq_lock: write_journal re-acquires it
@@ -309,21 +411,24 @@ class BaseParameterServer:
         No-op without ``journal_dir``."""
         if not self.journal_dir:
             return None
-        with self._journal_lock:
+        with self._journal_lock, self._tracer.span(
+            "ps.journal_write", server=self.telemetry_label
+        ):
             with self._seq_lock:
                 seq_table = dict(self.seq_table)
                 weights = self.get_parameters()
+                applied = self._applied_seen
             path = journal_io.save_journal(
                 self.journal_dir,
                 weights,
                 seq_table,
                 meta={
                     "mode": self.mode,
-                    "updates_applied": self.updates_applied,
+                    "updates_applied": applied,
                 },
             )
-            self.journal_writes += 1
-            self._last_journal_at = self.updates_applied
+            self._m_journal_writes.inc()
+            self._last_journal_at = applied
             return path
 
     # -- lifecycle -----------------------------------------------------
@@ -393,6 +498,21 @@ class HttpServer(BaseParameterServer):
                     payload = json.dumps(server.status()).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                if path == "/metrics":
+                    # ISSUE 5: the whole process's registry (serving +
+                    # PS + fault counters), Prometheus text format. A
+                    # plain extra route — legacy pickle clients never
+                    # touch it, so old wires are unaffected; renders
+                    # through the REAL registry even under null mode
+                    # (everything recorded before the flip stays
+                    # scrapeable).
+                    payload = telemetry.scrape_text().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", telemetry.CONTENT_TYPE)
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)

@@ -39,6 +39,7 @@ import time
 
 import numpy as np
 
+from elephas_tpu import telemetry
 from elephas_tpu.serving.kv_cache import (
     SlotKVCache,
     chunked_prefill_forward,
@@ -206,7 +207,6 @@ class InferenceEngine:
         )
         self._rules = rules
         self._seed = int(seed)
-        self.total_generated = 0
         # slots mid-chunked-prefill: slot -> [Admission, progress]
         # (progress = prompt tokens already resident, incl. any copied
         # prefix; the slot joins decode only once progress == len(prompt))
@@ -220,11 +220,68 @@ class InferenceEngine:
         # feeds stats()/tests and evicts oldest past the bound
         self.finished: dict[int, Request] = {}
         self._finished_bound = 4096
-        self.finished_count = 0
-        # eviction from `finished` is LOUD (ISSUE 4 satellite): counter
-        # + warning, and requests of an in-flight run() call are exempt
-        self.finished_evicted = 0
         self._protected: set[int] = set()
+        # warning cadence for _evict_finished: a PLAIN count, never the
+        # registry counter (which reads 0 under telemetry null mode)
+        self._evictions_seen = 0
+
+        # -- telemetry (ISSUE 5): the registry/tracer captured HERE are
+        # the engine's for life, so an engine built under null mode
+        # stays ~zero-overhead even if the global flag flips later.
+        # Counters are report-only views (`total_generated` etc. read
+        # them back); nothing below drives control flow.
+        treg = telemetry.registry()
+        self._telemetry_registry = treg
+        self._tracer = telemetry.tracer()
+        eid = telemetry.instance_label()
+        self.telemetry_label = eid
+
+        def _c(name, help_):
+            return treg.counter(
+                name, help_, labels=("engine",)
+            ).labels(engine=eid)
+
+        self._m_tokens = _c(
+            "elephas_serving_tokens_generated_total",
+            "Generated tokens emitted by the serving engine",
+        )
+        self._m_finished = _c(
+            "elephas_serving_requests_finished_total",
+            "Requests that completed (EOS or token budget)",
+        )
+        self._m_finished_evicted = _c(
+            "elephas_serving_finished_evicted_total",
+            "Finished requests evicted from the bounded result registry "
+            "before the caller consumed them",
+        )
+        self._m_decode_windows = _c(
+            "elephas_serving_decode_windows_total",
+            "Arena-wide decode window dispatches",
+        )
+        self._m_prefill_stalls = _c(
+            "elephas_serving_prefill_stall_slots_total",
+            "Mid-prefill slots deferred to a later step because the "
+            "per-step chunk-token budget was exhausted",
+        )
+        self._m_ttft = treg.histogram(
+            "elephas_serving_ttft_seconds",
+            "Submit-to-first-token latency of served requests",
+            labels=("engine",),
+        ).labels(engine=eid)
+        self._m_itl = treg.histogram(
+            "elephas_serving_inter_token_seconds",
+            "Arrival gap between consecutive tokens of one request",
+            labels=("engine",),
+        ).labels(engine=eid)
+        treg.gauge(
+            "elephas_serving_slots", "KV-cache slots in the arena",
+            labels=("engine",),
+        ).labels(engine=eid).set(self.num_slots)
+        treg.gauge(
+            "elephas_serving_kv_arena_bytes",
+            "Host-side size estimate of the full (f32) KV arena",
+            labels=("engine",),
+        ).labels(engine=eid).set(self.arena.nbytes())
 
         maxlen, arena = self.maxlen, self.arena
 
@@ -516,9 +573,17 @@ class InferenceEngine:
         this guard, the exception unwound through step() after the
         scheduler had recorded the token but before reclaim, leaking
         the KV slot for the engine's lifetime."""
-        self.total_generated += 1
+        self._m_tokens.inc()
         slot = req.slot
-        req.token_times.append(time.perf_counter())
+        now = time.perf_counter()
+        req.token_times.append(now)
+        # latency histograms feed straight off the per-request arrival
+        # times stats() already reports — one recording site, no drift
+        if len(req.token_times) == 1:
+            if req.submit_time is not None:
+                self._m_ttft.observe(now - req.submit_time)
+        else:
+            self._m_itl.observe(now - req.token_times[-2])
         done = self.scheduler.on_token(slot, token)
         if req.on_token is not None:
             try:
@@ -535,17 +600,24 @@ class InferenceEngine:
             req.finish_time = req.token_times[-1]
             self.scheduler.reclaim(slot)
             self._set_active(slot, False)
-            self.finished_count += 1
+            self._m_finished.inc()
             self.finished[req.rid] = req
             self._evict_finished()
         return done
 
     def _evict_finished(self) -> None:
-        """Trim the bounded finished-request registry — LOUDLY (warning
-        + ``finished_evicted`` counter; silent eviction lost results
-        under slow consumers), and never evicting a request an
-        in-flight :meth:`run` call has yet to return (the registry may
-        temporarily exceed its bound instead)."""
+        """Trim the bounded finished-request registry — LOUDLY but
+        RATE-LIMITED (ISSUE 5 satellite): the registry-backed
+        ``finished_evicted`` counter keeps EVERY increment for stats
+        and scrapes, while the warning fires only on the first eviction
+        and every 1024th after — a hot loop evicting per token cannot
+        turn the log into the bottleneck. The warning cadence runs on a
+        PLAIN count (telemetry never drives control flow — under null
+        mode the registry counter reads 0 forever, which would make
+        ``0 % 1024 == 0`` fire the warning on EVERY eviction). Requests
+        an in-flight :meth:`run` call has yet to return are never
+        evicted (the registry may temporarily exceed its bound
+        instead)."""
         while len(self.finished) > self._finished_bound:
             if len(self.finished) - len(self._protected) <= 0:
                 return  # only protected residents over the bound — a
@@ -559,15 +631,16 @@ class InferenceEngine:
             if victim is None:
                 return  # every resident request is protected
             self.finished.pop(victim)
-            self.finished_evicted += 1
-            if self.finished_evicted == 1 or \
-                    self.finished_evicted % 1024 == 0:
+            self._m_finished_evicted.inc()
+            self._evictions_seen += 1
+            evicted = self._evictions_seen
+            if evicted == 1 or evicted % 1024 == 0:
                 logger.warning(
                     "finished-request registry hit its bound (%d): "
                     "evicted request %d (%d evicted so far) — consume "
                     "results promptly or keep your own Request handles "
                     "from submit()",
-                    self._finished_bound, victim, self.finished_evicted,
+                    self._finished_bound, victim, evicted,
                 )
 
     def _set_active(self, slot: int, value: bool) -> None:
@@ -600,6 +673,10 @@ class InferenceEngine:
     def _prefill_wave(self, admitted: list[Request]) -> None:
         """Prefill one admission wave: ONE program launch per prompt
         bucket covers every request of that bucket in the wave."""
+        with self._tracer.span("serve.prefill_wave", reqs=len(admitted)):
+            self._prefill_wave_inner(admitted)
+
+    def _prefill_wave_inner(self, admitted: list[Request]) -> None:
         by_bucket: dict[int, list[Request]] = {}
         for req in admitted:
             b = self.scheduler.bucket_for(len(req.prompt))
@@ -654,6 +731,13 @@ class InferenceEngine:
         inside this same call (their suffix items must be present too).
         Returns ``(request, token, done)`` emissions of finalized
         requests."""
+        with self._tracer.span(
+            "serve.chunk", width=width, slots=len(items),
+            copies=len(copies),
+        ):
+            return self._run_chunk_inner(items, width, copies)
+
+    def _run_chunk_inner(self, items: list, width: int, copies=()):
         rows = np.zeros((self.num_slots, width), np.int32)
         offs = np.zeros((self.num_slots,), np.int32)
         clens = np.zeros((self.num_slots,), np.int32)
@@ -754,6 +838,7 @@ class InferenceEngine:
         if not self._prefilling:
             return emitted
         budget = self._prefill_budget
+        served: set[int] = set()
         while self._prefilling and budget > 0:
             # the budget caps TOTAL prefill tokens this step, not per
             # call: with several long prompts mid-prefill, slots beyond
@@ -771,11 +856,20 @@ class InferenceEngine:
                     self.prefill_chunk, len(adm.req.prompt) - progress
                 )
                 items.append((adm, progress, take))
+                served.add(slot)
                 budget -= take
             emitted.extend(self._run_chunk(items, self.prefill_chunk))
             for adm, progress, take in items:
                 if adm.slot in self._prefilling:
                     self._prefilling[adm.slot][1] = progress + take
+        stalled = sum(1 for s in self._prefilling if s not in served)
+        if stalled:
+            # chunk-budget stall: slots that got NO chunk this step and
+            # wait for the next one — the bounded-latency trade the
+            # budget exists to make, but a rising rate means arrivals
+            # outpace the budget. Slots that advanced this step are not
+            # stalled even if they remain mid-prefill.
+            self._m_prefill_stalls.inc(stalled)
         return emitted
 
     def step(self) -> list[tuple[Request, int, bool]]:
@@ -800,21 +894,26 @@ class InferenceEngine:
             slot not in self._prefilling for slot in self.scheduler.active
         ):
             return emitted
-        (self._caches, self._lengths, self._last, self._key,
-         window) = self._decode_jit(
-            self._weights, self._caches, self._lengths, self._last,
-            self._temps, self._sync_active(), self._key,
-        )
-        toks = self._host(window)  # [steps_per_sync, num_slots]
-        for i in range(self.steps_per_sync):
-            if not self.scheduler.active:
-                break  # window tail decoded garbage for empty slots
-            self.scheduler.note_step()
-            for slot, req in sorted(self.scheduler.active.items()):
-                if slot in self._prefilling:
-                    continue  # mid-prefill: no decode tokens yet
-                done = self._emit(req, int(toks[i, slot]))
-                emitted.append((req, req.tokens[-1], done))
+        self._m_decode_windows.inc()
+        with self._tracer.span(
+            "serve.decode_window", steps=self.steps_per_sync,
+            active=len(self.scheduler.active),
+        ):
+            (self._caches, self._lengths, self._last, self._key,
+             window) = self._decode_jit(
+                self._weights, self._caches, self._lengths, self._last,
+                self._temps, self._sync_active(), self._key,
+            )
+            toks = self._host(window)  # [steps_per_sync, num_slots]
+            for i in range(self.steps_per_sync):
+                if not self.scheduler.active:
+                    break  # window tail decoded garbage for empty slots
+                self.scheduler.note_step()
+                for slot, req in sorted(self.scheduler.active.items()):
+                    if slot in self._prefilling:
+                        continue  # mid-prefill: no decode tokens yet
+                    done = self._emit(req, int(toks[i, slot]))
+                    emitted.append((req, req.tokens[-1], done))
         return emitted
 
     def stream(self):
@@ -859,6 +958,42 @@ class InferenceEngine:
         return drained
 
     # -- introspection -------------------------------------------------
+
+    # Telemetry views (ISSUE 5 satellite): the registry counters are
+    # the ONLY store — these attributes read them back, so stats(),
+    # scrape(), and the bench can never drift apart. Under null mode
+    # they read 0 (telemetry off zeroes reporting, never behavior).
+
+    @property
+    def total_generated(self) -> int:
+        return int(self._m_tokens.value)
+
+    @property
+    def finished_count(self) -> int:
+        return int(self._m_finished.value)
+
+    @property
+    def finished_evicted(self) -> int:
+        return int(self._m_finished_evicted.value)
+
+    def scrape(self) -> str:
+        """This engine's registry rendered as Prometheus exposition
+        text (the in-process scrape surface; the HTTP surface is the
+        parameter server's ``GET /metrics``). Empty when the engine was
+        constructed under telemetry null mode."""
+        return telemetry.render(self._telemetry_registry)
+
+    def release_telemetry(self) -> None:
+        """Retire this engine's labeled series — its own, its
+        scheduler's, and its prefix cache's — from the process
+        registry. Hosts that construct engines in a loop (the bench's
+        alternating rounds, per-request test engines) call this when an
+        engine is done so scrape output doesn't accumulate dead
+        incarnations; never called implicitly, because scraping a
+        finished engine's counters is a supported shape. Object-held
+        views (``total_generated`` etc.) keep working."""
+        telemetry.remove_series(engine=self.telemetry_label)
+        self.scheduler.release_telemetry()
 
     def compile_stats(self) -> dict:
         """Compiled-program counts (the compile-count introspection

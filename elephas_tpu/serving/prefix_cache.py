@@ -38,6 +38,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from elephas_tpu import telemetry
+
 
 @dataclass
 class _Node:
@@ -73,11 +75,59 @@ class PrefixCache:
         self._root = _Node()
         self._entries: dict[int, CacheEntry] = {}
         self._clock = 0
-        # counters for stats()/bench — monotonic over the cache's life
-        self.hits = 0
-        self.misses = 0
-        self.reused_tokens = 0
-        self.evictions = 0
+        # counters for stats()/bench (ISSUE 5): registry-backed, read
+        # back through the properties below — one store, no drift. The
+        # logical `_clock` above stays plain: it DRIVES eviction order
+        # (control flow), which telemetry never may.
+        reg = telemetry.registry()
+        cid = telemetry.instance_label()
+        self.telemetry_label = cid
+
+        def _c(name, help_):
+            return reg.counter(
+                name, help_, labels=("cache",)
+            ).labels(cache=cid)
+
+        self._m_hits = _c(
+            "elephas_prefix_cache_hits_total",
+            "Admissions served a donor copy from the prefix cache",
+        )
+        self._m_misses = _c(
+            "elephas_prefix_cache_misses_total",
+            "Admissions that landed cold (no usable cached prefix)",
+        )
+        self._m_reused_tokens = _c(
+            "elephas_prefix_cache_reused_tokens_total",
+            "Prompt tokens served by donor copy instead of prefill",
+        )
+        self._m_evictions = _c(
+            "elephas_prefix_cache_evictions_total",
+            "Donor entries evicted under slot pressure (LRU)",
+        )
+
+    # registry-backed counter views (see __init__)
+
+    @property
+    def hits(self) -> int:
+        return int(self._m_hits.value)
+
+    @property
+    def misses(self) -> int:
+        return int(self._m_misses.value)
+
+    @property
+    def reused_tokens(self) -> int:
+        return int(self._m_reused_tokens.value)
+
+    @property
+    def evictions(self) -> int:
+        return int(self._m_evictions.value)
+
+    def release_telemetry(self) -> None:
+        """Retire this cache's labeled series from the process registry
+        (cascaded from the owning scheduler/engine). The counter views
+        above keep reading their own series."""
+        telemetry.remove_series(cache=self.telemetry_label)
 
     # -- registration ---------------------------------------------------
 
@@ -184,13 +234,13 @@ class PrefixCache:
         if entry is not None:
             self._clock += 1
             entry.last_use = self._clock
-        self.hits += 1
-        self.reused_tokens += int(reuse_len)
+        self._m_hits.inc()
+        self._m_reused_tokens.inc(int(reuse_len))
 
     def record_miss(self) -> None:
         """An admission landed with no reuse (no match, or the
         cold-fallback path dropped its pinned donor)."""
-        self.misses += 1
+        self._m_misses.inc()
 
     def flush(self) -> list[int]:
         """Drop EVERY entry (donors and leased alike) and return the
@@ -218,7 +268,7 @@ class PrefixCache:
             return None
         victim = min(victims, key=lambda e: (e.last_use, e.slot))
         self.remove(victim.slot)
-        self.evictions += 1
+        self._m_evictions.inc()
         return victim.slot
 
     # -- introspection --------------------------------------------------

@@ -29,6 +29,7 @@ import itertools
 from collections import deque
 from dataclasses import dataclass, field
 
+from elephas_tpu import telemetry
 from elephas_tpu.serving.prefix_cache import PrefixCache
 
 
@@ -135,15 +136,46 @@ class Scheduler:
         # accidental-hit traffic would drag every admission through
         # the donor path)
         self.prefix_min_reuse = max(1, int(prefix_min_reuse))
-        # occupancy accounting for the serving bench
+        # occupancy accounting for the serving bench — plain ints, the
+        # engine reads them for round-scoped occupancy math
         self._steps = 0
         self._busy_slot_steps = 0
+        # telemetry (ISSUE 5): admission counters by kind + a queue-
+        # depth gauge, report-only (the schedule itself never reads
+        # them — gang determinism is untouched)
+        reg = telemetry.registry()
+        sid = telemetry.instance_label()
+        self.telemetry_label = sid
+        admissions = reg.counter(
+            "elephas_serving_admissions_total",
+            "Requests admitted into KV slots, by admission kind",
+            labels=("scheduler", "kind"),
+        )
+        self._m_admit_cold = admissions.labels(scheduler=sid, kind="cold")
+        self._m_admit_hit = admissions.labels(
+            scheduler=sid, kind="prefix_hit"
+        )
+        self._m_waiting = reg.gauge(
+            "elephas_serving_waiting_requests",
+            "Requests queued behind a full slot arena",
+            labels=("scheduler",),
+        ).labels(scheduler=sid)
+
+    def release_telemetry(self) -> None:
+        """Retire this scheduler's labeled series (and its prefix
+        cache's, if any) from the process registry — the engine's
+        ``release_telemetry()`` cascades here. Explicit-only; see
+        ``Registry.remove_series``."""
+        telemetry.remove_series(scheduler=self.telemetry_label)
+        if self.prefix_cache is not None:
+            self.prefix_cache.release_telemetry()
 
     # -- submission ----------------------------------------------------
 
     def submit(self, request: Request) -> Request:
         request.rid = next(self._ids) if request.rid is None else request.rid
         self.waiting.append(request)
+        self._m_waiting.set(len(self.waiting))
         return request
 
     def make_request(self, prompt, max_new_tokens, temperature=0.0,
@@ -212,6 +244,8 @@ class Scheduler:
             req.slot = slot
             req.reused_tokens = reuse
             self.active[slot] = req
+            (self._m_admit_hit if donor is not None
+             else self._m_admit_cold).inc()
             admitted.append(
                 Admission(req=req, slot=slot, donor_slot=donor,
                           reuse_len=reuse)
@@ -222,6 +256,7 @@ class Scheduler:
         if cache is not None:
             for slot in pinned:
                 cache.unpin(slot)
+        self._m_waiting.set(len(self.waiting))
         return admitted
 
     def on_prefill_complete(self, req: Request) -> None:

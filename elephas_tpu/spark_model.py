@@ -28,6 +28,7 @@ import threading
 
 import numpy as np
 
+from elephas_tpu import telemetry
 from elephas_tpu.data.rdd import Rdd
 from elephas_tpu.parallel.mesh import worker_mesh
 from elephas_tpu.utils import rdd_utils
@@ -377,9 +378,20 @@ class SparkModel:
             self._publisher.stop()
             self._publisher = None
 
+    def scrape(self) -> str:
+        """The process telemetry registry (training, PS, serving, and
+        chaos counters alike) rendered as Prometheus exposition text —
+        the in-process twin of the HTTP parameter server's
+        ``GET /metrics`` (ISSUE 5)."""
+        return telemetry.scrape_text()
+
     def _publish_weights(self, final: bool = False) -> None:
         if self._parameter_server is None:
             return
+        telemetry.registry().counter(
+            "elephas_ps_weight_publications_total",
+            "Master-weight snapshots published to the external store",
+        ).inc()
         weights = self._get_runner().host_weights()
         if self._publisher is not None and not final:
             self._publisher.publish(weights)
@@ -682,7 +694,14 @@ class SparkModel:
 
         self.start_server(restore_journal=bool(resume))
         try:
-            callbacks = []
+            # epoch boundaries land on the shared trace timeline
+            # (ISSUE 5) so training cadence can be correlated with PS
+            # round-trips and chaos events in one Chrome trace
+            callbacks = [
+                lambda epoch, loss: telemetry.emit(
+                    "fit.epoch", epoch=int(epoch), loss=float(loss)
+                )
+            ]
             if self._parameter_server is not None:
                 # keep the external weight store live at epoch boundaries
                 # (run_epochs syncs the master model before each callback)

@@ -1,0 +1,81 @@
+"""Unified telemetry (ISSUE 5): metrics registry, logical-clock event
+tracing, and Prometheus exposition — one layer under the serving
+engine, the parameter servers/clients, the workers, and the chaos
+harness.
+
+Quick tour::
+
+    from elephas_tpu import telemetry
+
+    reg = telemetry.registry()                 # no-op under null mode
+    tokens = reg.counter(
+        "elephas_serving_tokens_generated_total",
+        "Tokens emitted by the serving engine", labels=("engine",),
+    ).labels(engine="0")
+    tokens.inc()
+
+    with telemetry.trace_span("prefill", req=42):
+        ...                                    # wall time export-only
+
+    print(telemetry.scrape_text())             # Prometheus text
+    telemetry.tracer().export_chrome_trace("/tmp/trace.json")
+
+    telemetry.set_null(True)                   # everything above ~free
+
+Two contracts everything else in the codebase leans on:
+
+- **Telemetry never drives control flow.** Correctness-bearing state
+  (journal cadence, sequence tables, slot bookkeeping) keeps plain
+  variables; registry metrics are report-only views of them — which is
+  what makes null mode safe to flip.
+- **Wall time is export-only.** Ordering comes from logical sequence
+  numbers; gang/SPMD schedules stay deterministic (the PR-4 contract).
+"""
+
+from elephas_tpu.telemetry.events import (  # noqa: F401
+    EventTracer,
+    NullTracer,
+    default_tracer,
+    emit,
+    trace_span,
+    tracer,
+)
+from elephas_tpu.telemetry.expose import (  # noqa: F401
+    CONTENT_TYPE,
+    render,
+    scrape_text,
+)
+from elephas_tpu.telemetry.registry import (  # noqa: F401
+    DEFAULT_TIME_BUCKETS,
+    NULL_METRIC,
+    NullRegistry,
+    Registry,
+    default_registry,
+    instance_label,
+    null_mode,
+    registry,
+    remove_series,
+    set_null,
+)
+
+__all__ = [
+    "Registry",
+    "NullRegistry",
+    "EventTracer",
+    "NullTracer",
+    "DEFAULT_TIME_BUCKETS",
+    "NULL_METRIC",
+    "CONTENT_TYPE",
+    "registry",
+    "default_registry",
+    "instance_label",
+    "set_null",
+    "null_mode",
+    "remove_series",
+    "tracer",
+    "default_tracer",
+    "trace_span",
+    "emit",
+    "render",
+    "scrape_text",
+]

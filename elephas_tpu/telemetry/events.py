@@ -1,0 +1,245 @@
+"""Logical-clock event tracing (ISSUE 5 tentpole, part 2).
+
+A bounded ring buffer of events, each stamped with a monotonic
+**logical sequence number** (the ordering authority) plus a wall-clock
+capture that exists ONLY for export — Chrome-trace timelines and
+recovery-window measurement. Nothing in the runtime reads an event's
+wall time to make a decision; this preserves the gang/SPMD determinism
+contract the serving scheduler and prefix cache already carry (their
+logical clocks stay the only clocks on control paths).
+
+Two event shapes:
+
+- **instants** (:meth:`EventTracer.emit`): one point on the timeline —
+  a chaos injection, a PS kill, a worker retry;
+- **spans** (:meth:`EventTracer.span` / :func:`trace_span`): a
+  ``with``-scoped duration — a prefill wave, a decode window, a
+  kill→recovery window. A span records ONE complete event at exit
+  (single ring append — atomic under the GIL), carrying its begin/end
+  sequence numbers and its wall duration.
+
+The ring (``collections.deque(maxlen=...)``) keeps the NEWEST events
+under overflow; export renders whatever survived. The Chrome-trace
+exporter (:meth:`export_chrome_trace`) writes the standard
+``traceEvents`` JSON consumable by ``chrome://tracing`` / Perfetto, so
+serving waves, PS round-trips, and chaos injections land on one
+timeline.
+
+Null mode (:func:`~elephas_tpu.telemetry.registry.set_null`) swaps
+:func:`tracer` for a no-op tracer, same as the metrics registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from elephas_tpu.telemetry import registry as _registry_mod
+
+DEFAULT_CAPACITY = 8192
+
+
+class _Span:
+    """Reusable span context manager: captures begin seq/wall on enter,
+    appends one complete event on exit."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_seq0", "_t0")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+        self._seq0 = 0
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._seq0 = self._tracer._next_seq()
+        # wall time: EXPORT-ONLY (never control flow) — see module doc
+        self._t0 = time.time()
+        return self
+
+    def set(self, **kw) -> None:
+        """Attach/overwrite span args mid-flight (e.g. an outcome flag
+        only known at the end of the spanned work)."""
+        self._args.update(kw)
+
+    def __exit__(self, *exc):
+        self._tracer._append(
+            name=self._name,
+            ph="X",
+            seq=self._tracer._next_seq(),
+            seq_begin=self._seq0,
+            ts=self._t0,
+            dur=time.time() - self._t0,
+            args=dict(self._args),
+        )
+        return False
+
+
+class EventTracer:
+    """Bounded ring of instants and spans; see the module docstring."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._seq_lock = threading.Lock()
+        self._seq_next = 0
+
+    # -- recording -----------------------------------------------------
+
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            seq = self._seq_next
+            self._seq_next += 1
+            return seq
+
+    @property
+    def seq(self) -> int:
+        """The next sequence number to be assigned — snapshot this
+        before a run to filter :meth:`events` to that run only."""
+        with self._seq_lock:
+            return self._seq_next
+
+    def _append(self, *, name, ph, seq, ts, args, dur=None,
+                seq_begin=None):
+        event = {
+            "name": name,
+            "ph": ph,
+            "seq": seq,
+            "ts": ts,
+            "tid": threading.get_ident(),
+            "args": args,
+        }
+        if dur is not None:
+            event["dur"] = dur
+            event["seq_begin"] = seq_begin
+        self._ring.append(event)  # deque(maxlen): atomic, drops oldest
+
+    def emit(self, name: str, **args) -> int:
+        """Record one instant event; returns its logical sequence
+        number (callers may correlate on it — it is the only ordering
+        a consumer should trust)."""
+        seq = self._next_seq()
+        self._append(name=name, ph="i", seq=seq, ts=time.time(), args=args)
+        return seq
+
+    def span(self, name: str, **args) -> _Span:
+        """``with tracer.span("prefill", req=rid): ...`` — records one
+        complete event at exit with begin/end sequence numbers and the
+        wall duration."""
+        return _Span(self, name, args)
+
+    # -- reading / export ----------------------------------------------
+
+    def events(self, since_seq: int = 0, name: str | None = None) -> list:
+        """Snapshot of surviving events with ``seq >= since_seq`` (and
+        matching ``name``, when given), in ring order."""
+        return [
+            dict(e)
+            for e in list(self._ring)
+            if e["seq"] >= since_seq and (name is None or e["name"] == name)
+        ]
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def export_chrome_trace(self, path: str, since_seq: int = 0) -> int:
+        """Write the surviving events as Chrome-trace ``traceEvents``
+        JSON (load in ``chrome://tracing`` / Perfetto / TensorBoard's
+        trace viewer). Spans become ``ph="X"`` complete events with
+        microsecond ``ts``/``dur``; instants become ``ph="i"``. Returns
+        the number of events written."""
+        pid = os.getpid()
+        out = []
+        for e in self.events(since_seq):
+            rec = {
+                "name": e["name"],
+                "ph": e["ph"],
+                "pid": pid,
+                "tid": e["tid"],
+                "ts": e["ts"] * 1e6,
+                "args": dict(e["args"], seq=e["seq"]),
+            }
+            if e["ph"] == "X":
+                rec["dur"] = e["dur"] * 1e6
+                rec["args"]["seq_begin"] = e["seq_begin"]
+            else:
+                rec["s"] = "t"  # instant scope: thread
+            out.append(rec)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+        return len(out)
+
+
+class _NullSpan:
+    """Reusable no-op span (still usable as ``with ... as sp``)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **kw):
+        pass
+
+
+class NullTracer:
+    """No-op tracer handed out under null mode."""
+
+    _NULL_SPAN = _NullSpan()
+
+    def emit(self, name, **args):
+        return -1
+
+    def span(self, name, **args):
+        return self._NULL_SPAN
+
+    @property
+    def seq(self) -> int:
+        return 0
+
+    def events(self, since_seq=0, name=None):
+        return []
+
+    def clear(self):
+        pass
+
+    def export_chrome_trace(self, path, since_seq=0):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": []}, f)
+        return 0
+
+
+_default_tracer = EventTracer()
+_null_tracer = NullTracer()
+
+
+def tracer():
+    """The process tracer (or the no-op tracer under null mode)."""
+    if _registry_mod.null_mode():
+        return _null_tracer
+    return _default_tracer
+
+
+def default_tracer() -> EventTracer:
+    """The real default tracer regardless of null mode (export
+    surfaces read through this)."""
+    return _default_tracer
+
+
+def trace_span(name: str, **args):
+    """Module-level convenience: ``with trace_span("prefill", req=3):``
+    on the default tracer (no-op under null mode)."""
+    return tracer().span(name, **args)
+
+
+def emit(name: str, **args) -> int:
+    """Module-level convenience for one instant event."""
+    return tracer().emit(name, **args)

@@ -1,0 +1,96 @@
+"""Prometheus text-format exposition (ISSUE 5 tentpole, part 3).
+
+One renderer for every scrape surface: ``GET /metrics`` on the HTTP
+parameter server, ``InferenceEngine.scrape()``, and
+``SparkModel.scrape()`` all emit the text produced here, so the wire
+format has exactly one home. The format is Prometheus exposition
+version 0.0.4 (``# HELP`` / ``# TYPE`` comments, ``le``-cumulative
+histogram buckets, ``_sum``/``_count`` series).
+"""
+
+from __future__ import annotations
+
+from elephas_tpu.telemetry import registry as _registry_mod
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return (
+        s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    v = float(v)
+    if v != v:
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _labels_str(names, values, extra=()) -> str:
+    pairs = [
+        f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)
+    ]
+    pairs.extend(f'{n}="{_escape_label(v)}"' for n, v in extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def render(registry=None) -> str:
+    """The registry's current state as Prometheus exposition text.
+
+    Defaults to the REAL process registry (not the null stand-in), so
+    a scrape during a null-mode window still shows everything recorded
+    while telemetry was on.
+    """
+    if registry is None:
+        registry = _registry_mod.default_registry()
+    lines: list[str] = []
+    for fam in registry.collect():
+        kind = fam.kind
+        lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        lines.append(f"# TYPE {fam.name} {kind}")
+        for values, child in fam.series():
+            labels = _labels_str(fam.labelnames, values)
+            if kind in ("counter", "gauge"):
+                try:
+                    v = child.value
+                except Exception as e:  # callback gauges may die
+                    lines.append(
+                        f"# callback for {fam.name}{labels} failed: {e!r}"
+                    )
+                    continue
+                lines.append(f"{fam.name}{labels} {_fmt(v)}")
+                continue
+            counts, total_count, total_sum = child.snapshot()
+            cumulative = 0
+            for bound, c in zip(child._bounds, counts):
+                cumulative += c
+                le = _labels_str(
+                    fam.labelnames, values, extra=(("le", _fmt(bound)),)
+                )
+                lines.append(f"{fam.name}_bucket{le} {cumulative}")
+            inf = _labels_str(
+                fam.labelnames, values, extra=(("le", "+Inf"),)
+            )
+            lines.append(f"{fam.name}_bucket{inf} {total_count}")
+            lines.append(f"{fam.name}_sum{labels} {_fmt(total_sum)}")
+            lines.append(f"{fam.name}_count{labels} {total_count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def scrape_text() -> str:
+    """The default registry rendered — what ``GET /metrics`` serves."""
+    return render()

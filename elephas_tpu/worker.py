@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elephas_tpu import telemetry
 from elephas_tpu.parallel.mesh import shard_map_compat
 from elephas_tpu.utils import sockets
 
@@ -1140,6 +1141,31 @@ class AsynchronousSparkWorker(SparkWorker):
         self.ps_retries = max(0, int(ps_retries))
         self.ps_retry_max_delay = float(ps_retry_max_delay)
         self.client_id = client_id
+        # telemetry (ISSUE 5): the supervised retry loop and sync
+        # cadence become observable — a rising retry rate is the
+        # earliest signal of a struggling PS, visible on the same
+        # scrape as the server's own counters
+        reg = telemetry.registry()
+        wid = telemetry.instance_label()
+        self.telemetry_label = wid
+        self._tracer = telemetry.tracer()
+        self._m_sync_periods = reg.counter(
+            "elephas_worker_sync_periods_total",
+            "Completed pull-train-push sync periods",
+            labels=("worker",),
+        ).labels(worker=wid)
+        self._m_retries = reg.counter(
+            "elephas_worker_ps_retries_total",
+            "Supervised re-runs of a sync period after a PS failure",
+            labels=("worker",),
+        ).labels(worker=wid)
+
+    def release_telemetry(self) -> None:
+        """Retire this worker's labeled series from the process
+        registry. Explicit-only (see ``Registry.remove_series``):
+        post-fit scrapes showing what the partitions did are a
+        supported shape, so retirement is the host's call."""
+        telemetry.remove_series(worker=self.telemetry_label)
 
     def _client(self, model=None):
         from elephas_tpu.parameter.client import HttpClient, SocketClient
@@ -1209,12 +1235,24 @@ class AsynchronousSparkWorker(SparkWorker):
         """One sync period under the ISSUE 3 supervision contract:
         capped-backoff re-runs survive a PS outage that outlasts the
         client's own reconnect retries; the final failure propagates
-        so the driver's failure budget can count this worker."""
+        so the driver's failure budget can count this worker. Each
+        re-run counts in ``elephas_worker_ps_retries_total`` and lands
+        as a trace event (ISSUE 5) so outage windows line up with the
+        chaos timeline."""
+
+        def on_retry(attempt, exc):
+            self._m_retries.inc()
+            self._tracer.emit(
+                "worker.retry", worker=self.telemetry_label,
+                attempt=attempt, error=repr(exc),
+            )
+
         return sockets.retry_call(
             fn,
             retries=self.ps_retries,
             base_delay=0.25,
             max_delay=self.ps_retry_max_delay,
+            on_retry=on_retry,
         )
 
     def train(self, data_iterator):
@@ -1250,7 +1288,12 @@ class AsynchronousSparkWorker(SparkWorker):
                             subtract_params(model.get_weights(), before)
                         )
 
-                    self._supervised(sync_period)
+                    with self._tracer.span(
+                        "worker.sync_period",
+                        worker=self.telemetry_label,
+                    ):
+                        self._supervised(sync_period)
+                    self._m_sync_periods.inc()
                 # confirmed delivery: every pipelined push is acked (or
                 # sequence-deduplicated-resent) before this partition
                 # reports done — without this, a connection dying on
@@ -1278,6 +1321,7 @@ class AsynchronousSparkWorker(SparkWorker):
                 self._fit_period(model, xp, yp, batch_size)
                 after = model.get_weights()
                 sync.submit(subtract_params(after, before))
+                self._m_sync_periods.inc()
                 fresh = sync.freshest()
                 if fresh is not None:
                     before = fresh

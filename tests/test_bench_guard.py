@@ -201,13 +201,13 @@ class TestFaultPathLint:
     def _fault_path_files():
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         files = [os.path.join(root, "elephas_tpu", "utils", "sockets.py")]
-        for pkg in ("parameter", "fault", "serving"):
+        for pkg in ("parameter", "fault", "serving", "telemetry"):
             files.extend(
                 sorted(glob.glob(
                     os.path.join(root, "elephas_tpu", pkg, "*.py")
                 ))
             )
-        assert len(files) > 9  # the glob must actually find the modules
+        assert len(files) > 12  # the glob must actually find the modules
         return root, files
 
     def test_no_bare_or_swallowed_excepts_on_fault_paths(self):
@@ -234,6 +234,51 @@ class TestFaultPathLint:
             "swallowed exception on a fault/recovery path (tag with "
             "'fault-lint: allow <reason>' if truly intended):\n"
             + "\n".join(offences)
+        )
+
+
+class TestTelemetryWallClockLint:
+    """ISSUE 5 satellite: the telemetry determinism contract says wall
+    time is EXPORT-ONLY — control paths order themselves by logical
+    clocks. An ad-hoc ``time.time()`` creeping into the serving or PS
+    modules is exactly how a wall-clock comparison ends up steering a
+    gang-replicated schedule (processes disagree, schedules fork, the
+    SPMD contract breaks silently). ``elephas_tpu/telemetry/`` is the
+    one place wall capture belongs (it only exports it); everywhere
+    else on the serving/PS/fault paths an intentional use must carry a
+    ``telemetry-lint: allow`` tag with its reason. (``time.monotonic``
+    / ``perf_counter`` for local durations stay allowed — they never
+    cross processes.)"""
+
+    _WALL_CLOCK = re.compile(r"(?<![\w.])time\.time\(")
+
+    def test_no_adhoc_wall_clock_on_control_paths(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        files = [os.path.join(root, "elephas_tpu", "utils", "sockets.py")]
+        for pkg in ("parameter", "fault", "serving"):
+            files.extend(
+                sorted(glob.glob(
+                    os.path.join(root, "elephas_tpu", pkg, "*.py")
+                ))
+            )
+        assert len(files) > 9
+        offences = []
+        for path in files:
+            with open(path) as f:
+                lines = f.read().splitlines()
+            for i, line in enumerate(lines):
+                if not self._WALL_CLOCK.search(line):
+                    continue
+                window = lines[max(0, i - 1): min(len(lines), i + 2)]
+                if any("telemetry-lint: allow" in w for w in window):
+                    continue
+                rel = os.path.relpath(path, root)
+                offences.append(f"{rel}:{i + 1}: {line.strip()}")
+        assert not offences, (
+            "ad-hoc wall clock on a serving/PS control path — route it "
+            "through elephas_tpu.telemetry (events capture wall time "
+            "export-only) or tag the line with "
+            "'telemetry-lint: allow <reason>':\n" + "\n".join(offences)
         )
 
 

@@ -1,0 +1,650 @@
+"""ISSUE 5: the unified telemetry subsystem.
+
+Registry semantics (threaded exactness, bucket edges, null no-ops),
+ring-buffer wraparound, the Prometheus golden render, the ``/metrics``
+round-trip on a live HTTP parameter server, the no-drift contract
+between attribute views and the registry, and the chaos harness's
+trace-stream recovery span. The bench-side overhead gate lives in
+``bench.py --preset serving`` (slow smoke in test_serving_prefix).
+"""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elephas_tpu import telemetry
+
+
+@pytest.fixture()
+def not_null():
+    """Tests that flip null mode restore it; everything else asserts
+    the suite-wide default (on) so a leaked flip fails loudly."""
+    assert not telemetry.null_mode()
+    yield
+    assert not telemetry.null_mode()
+
+
+# -- registry ------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_threaded_increments_sum_exactly(self):
+        reg = telemetry.Registry()
+        c = reg.counter("t_threads_total", "x")
+        h = reg.histogram("t_threads_seconds", "x", buckets=(0.5,))
+
+        def work():
+            for _ in range(10_000):
+                c.inc()
+                h.observe(0.1)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 80_000
+        counts, total, hsum = h.snapshot()
+        assert total == 80_000 and counts[0] == 80_000
+        assert hsum == pytest.approx(8_000.0)
+
+    def test_get_or_create_and_mismatch(self):
+        reg = telemetry.Registry()
+        a = reg.counter("t_same_total", "x", labels=("k",))
+        assert reg.counter("t_same_total", "x", labels=("k",)) is a
+        # same name as a different kind or label schema must refuse
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("t_same_total", "x", labels=("k",))
+        with pytest.raises(ValueError, match="already registered"):
+            reg.counter("t_same_total", "x", labels=("other",))
+        with pytest.raises(ValueError, match="invalid metric name"):
+            reg.counter("bad name", "x")
+        h = reg.histogram("t_same_seconds", "x", buckets=(0.1, 1.0))
+        assert reg.histogram(
+            "t_same_seconds", "x", buckets=(1.0, 0.1)  # order-insensitive
+        ) is h
+        # a different ladder must refuse — observations would silently
+        # land in the first caller's buckets
+        with pytest.raises(ValueError, match="buckets"):
+            reg.histogram("t_same_seconds", "x", buckets=(5.0,))
+        with pytest.raises(ValueError):
+            a.labels(wrong="v")
+        with pytest.raises(ValueError):
+            a.labels(k="v").inc(-1)  # counters are monotonic
+        with pytest.raises(ValueError, match="call .labels"):
+            a.inc()  # labeled family needs a series
+
+    def test_label_children_are_distinct_and_cached(self):
+        reg = telemetry.Registry()
+        fam = reg.counter("t_labels_total", "x", labels=("who",))
+        fam.labels(who="a").inc(3)
+        fam.labels(who="b").inc(5)
+        assert fam.labels(who="a") is fam.labels(who="a")
+        assert fam.labels(who="a").value == 3
+        assert fam.labels(who="b").value == 5
+
+    def test_histogram_bucket_edges(self):
+        """``le`` is INCLUSIVE: an observation exactly on a bound lands
+        in that bound's bucket, epsilon above falls through."""
+        reg = telemetry.Registry()
+        h = reg.histogram("t_edges_seconds", "x", buckets=(0.1, 1.0))
+        for v in (0.05, 0.1, 0.100001, 1.0, 2.0):
+            h.observe(v)
+        counts, total, _ = h.snapshot()
+        assert counts == [2, 2, 1]  # (-inf,0.1], (0.1,1], (1,+inf)
+        assert total == 5
+        text = telemetry.render(reg)
+        assert 't_edges_seconds_bucket{le="0.1"} 2' in text
+        assert 't_edges_seconds_bucket{le="1"} 4' in text  # cumulative
+        assert 't_edges_seconds_bucket{le="+Inf"} 5' in text
+        assert "t_edges_seconds_count 5" in text
+
+    def test_gauge_set_inc_and_callback(self):
+        reg = telemetry.Registry()
+        g = reg.gauge("t_gauge", "x")
+        g.set(3)
+        g.inc(2)
+        g.dec()
+        assert g.value == 4
+        cb = reg.gauge("t_gauge_cb", "x")
+        cb.set_function(lambda: 7.5)
+        assert cb.value == 7.5
+        assert "t_gauge_cb 7.5" in telemetry.render(reg)
+
+    def test_render_golden(self):
+        """The full exposition format, byte-for-byte."""
+        reg = telemetry.Registry()
+        c = reg.counter("g_requests_total", "Requests served",
+                        labels=("engine",))
+        c.labels(engine="0").inc(4)
+        reg.gauge("g_slots", "Slots").set(8)
+        h = reg.histogram("g_ttft_seconds", "TTFT", buckets=(0.5, 1.0))
+        h.observe(0.25)
+        h.observe(2.0)
+        assert telemetry.render(reg) == (
+            "# HELP g_requests_total Requests served\n"
+            "# TYPE g_requests_total counter\n"
+            'g_requests_total{engine="0"} 4\n'
+            "# HELP g_slots Slots\n"
+            "# TYPE g_slots gauge\n"
+            "g_slots 8\n"
+            "# HELP g_ttft_seconds TTFT\n"
+            "# TYPE g_ttft_seconds histogram\n"
+            'g_ttft_seconds_bucket{le="0.5"} 1\n'
+            'g_ttft_seconds_bucket{le="1"} 1\n'
+            'g_ttft_seconds_bucket{le="+Inf"} 2\n'
+            "g_ttft_seconds_sum 2.25\n"
+            "g_ttft_seconds_count 2\n"
+        )
+
+    def test_label_value_escaping(self):
+        reg = telemetry.Registry()
+        reg.counter("t_esc_total", "x", labels=("p",)).labels(
+            p='a"b\\c\nd'
+        ).inc()
+        assert 'p="a\\"b\\\\c\\nd"' in telemetry.render(reg)
+
+
+# -- null mode -----------------------------------------------------------
+
+
+class TestNullMode:
+    def test_null_metrics_and_tracer_are_noops(self, not_null):
+        was = telemetry.set_null(True)
+        try:
+            assert was is False
+            reg = telemetry.registry()
+            c = reg.counter("n_total", "x")
+            c.inc(100)
+            assert c.value == 0
+            reg.histogram("n_seconds", "x").observe(1.0)
+            reg.gauge("n_g", "x").set(5)
+            assert reg.render() == ""
+            tr = telemetry.tracer()
+            assert tr.emit("never") == -1
+            with tr.span("never") as sp:
+                sp.set(ok=True)  # the span API still works, records nothing
+            assert tr.events() == []
+        finally:
+            telemetry.set_null(False)
+        # the REAL registry never saw the null-mode names
+        assert "n_total" not in telemetry.scrape_text()
+
+    def test_null_engine_pays_no_registry_series(self, not_null, serving_lm):
+        """An engine built under null mode records nothing and scrapes
+        empty — the bench's on-vs-null comparison shape."""
+        from elephas_tpu.serving import InferenceEngine
+
+        was = telemetry.set_null(True)
+        try:
+            engine = InferenceEngine(serving_lm, num_slots=4)
+        finally:
+            telemetry.set_null(was)
+        out = engine.run([([2, 3, 4], 4), ([3, 4, 5], 4)])
+        assert len(out) == 2
+        assert engine.scrape() == ""
+        assert engine.total_generated == 0  # view of a null metric
+        # behavior is untouched: the real token streams came back
+        assert all(len(seq) > 3 for seq in out.values())
+
+    def test_null_engine_eviction_warning_stays_rate_limited(
+        self, not_null, serving_lm, caplog
+    ):
+        """The eviction-warning cadence runs on a plain count, so null
+        mode (where the registry counter reads 0 forever — and
+        ``0 % 1024 == 0``) cannot flip the rate limit into a
+        per-eviction log flood."""
+        import logging
+
+        from elephas_tpu.serving import InferenceEngine
+
+        was = telemetry.set_null(True)
+        try:
+            engine = InferenceEngine(serving_lm, num_slots=2)
+        finally:
+            telemetry.set_null(was)
+        engine._finished_bound = 2
+        engine.finished = {rid: object() for rid in range(6)}
+        with caplog.at_level(
+            logging.WARNING, logger="elephas_tpu.serving.engine"
+        ):
+            engine._evict_finished()
+        assert len(engine.finished) == 2  # 4 evicted
+        warnings = [
+            r for r in caplog.records
+            if "finished-request registry" in r.message
+        ]
+        assert len(warnings) == 1  # first eviction only, not all 4
+
+
+# -- event tracer --------------------------------------------------------
+
+
+class TestEventTracer:
+    def test_ring_wraparound_keeps_newest(self):
+        tr = telemetry.EventTracer(capacity=8)
+        for i in range(20):
+            tr.emit("e", i=i)
+        evs = tr.events()
+        assert len(evs) == 8
+        assert [e["seq"] for e in evs] == list(range(12, 20))
+        assert [e["args"]["i"] for e in evs] == list(range(12, 20))
+
+    def test_logical_seqs_are_strictly_monotonic(self):
+        tr = telemetry.EventTracer(capacity=64)
+        seqs = []
+        threads = [
+            threading.Thread(
+                target=lambda: seqs.append(tr.emit("t"))
+            )
+            for _ in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(seqs)) == 16  # no duplicate sequence numbers
+
+    def test_span_records_duration_and_args(self):
+        tr = telemetry.EventTracer(capacity=16)
+        with tr.span("work", what="x") as sp:
+            time.sleep(0.01)
+            sp.set(outcome="done")
+        (e,) = tr.events(name="work")
+        assert e["ph"] == "X"
+        assert e["dur"] >= 0.01
+        assert e["args"] == {"what": "x", "outcome": "done"}
+        assert e["seq_begin"] < e["seq"]
+
+    def test_chrome_trace_export(self, tmp_path):
+        tr = telemetry.EventTracer(capacity=16)
+        tr.emit("instant", k=1)
+        with tr.span("window"):
+            pass
+        path = str(tmp_path / "trace.json")
+        assert tr.export_chrome_trace(path) == 2
+        with open(path) as f:
+            doc = json.load(f)
+        by_name = {e["name"]: e for e in doc["traceEvents"]}
+        assert by_name["instant"]["ph"] == "i"
+        assert by_name["window"]["ph"] == "X"
+        assert by_name["window"]["dur"] >= 0
+        assert {"pid", "tid", "ts"} <= set(by_name["window"])
+        assert by_name["instant"]["args"]["k"] == 1
+
+    def test_since_seq_filter(self):
+        tr = telemetry.EventTracer(capacity=32)
+        tr.emit("old")
+        cut = tr.seq
+        tr.emit("new")
+        assert [e["name"] for e in tr.events(since_seq=cut)] == ["new"]
+
+
+# -- subsystem integration ----------------------------------------------
+
+
+class TestHttpPsMetricsEndpoint:
+    def test_metrics_roundtrip_and_no_drift(self, not_null):
+        """GET /metrics on a live HTTP PS renders the process registry;
+        the server/client attribute views and the scraped text agree —
+        they are the same store (ISSUE 5 satellite)."""
+        from elephas_tpu.parameter.client import HttpClient
+        from elephas_tpu.parameter.server import HttpServer
+
+        weights = [np.zeros((8, 8), np.float32)]
+        server = HttpServer(weights, mode="asynchronous", port=0)
+        server.start()
+        try:
+            client = HttpClient(master=f"127.0.0.1:{server.port}")
+            client.update_parameters([np.ones((8, 8), np.float32)])
+            client.get_parameters()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=10
+            )
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read().decode("utf-8")
+            assert resp.status == 200
+            assert resp.getheader("Content-Type") == telemetry.CONTENT_TYPE
+            conn.close()
+            client.close()
+
+            sid = server.telemetry_label
+            assert (
+                f'elephas_ps_updates_applied_total{{server="{sid}"}} 1'
+                in body
+            )
+            cid = client.telemetry_label
+            sent_line = (
+                f'elephas_ps_client_bytes_sent_total{{client="{cid}"}} '
+                f"{client.bytes_sent}"
+            )
+            assert sent_line in body  # view == rendered registry value
+            assert client.bytes_sent > 0 and client.bytes_received > 0
+            # reset re-baselines the VIEW; the rendered counter stays
+            # monotonic (Prometheus contract)
+            client.reset_counters()
+            assert client.bytes_sent == 0
+            assert sent_line in telemetry.scrape_text()
+            # pull-time gauges render for this server
+            assert (
+                f'elephas_ps_journal_lag_updates{{server="{sid}"}}' in body
+            )
+        finally:
+            server.stop()
+
+    def test_status_and_metrics_agree(self, not_null):
+        from elephas_tpu.parameter.server import SocketServer
+
+        server = SocketServer([np.zeros((4,), np.float32)], port=0)
+        server.apply_update([np.ones((4,), np.float32)], "w0", 0)
+        server.apply_update([np.ones((4,), np.float32)], "w0", 0)  # dup
+        status = server.status()
+        assert status["updates_applied"] == server.updates_applied == 1
+        assert status["updates_duplicate"] == server.updates_duplicate == 1
+        sid = server.telemetry_label
+        text = telemetry.scrape_text()
+        assert (
+            f'elephas_ps_updates_duplicate_total{{server="{sid}"}} 1'
+            in text
+        )
+
+
+class TestEngineScrape:
+    def test_scrape_covers_serving_counters_no_drift(
+        self, not_null, serving_lm
+    ):
+        from elephas_tpu.serving import InferenceEngine
+
+        engine = InferenceEngine(serving_lm, num_slots=4, prefix_cache=True)
+        out = engine.run(
+            [([2, 3, 4, 5], 6), ([2, 3, 4, 5], 6), ([3, 4, 5], 4)]
+        )
+        assert len(out) == 3
+        text = engine.scrape()
+        eid = engine.telemetry_label
+        assert (
+            f'elephas_serving_tokens_generated_total{{engine="{eid}"}} '
+            f"{engine.total_generated}" in text
+        )
+        prompt_tokens = 4 + 4 + 3
+        assert engine.total_generated == sum(
+            len(seq) for seq in out.values()
+        ) - prompt_tokens
+        assert (
+            f'elephas_serving_requests_finished_total{{engine="{eid}"}} 3'
+            in text
+        )
+        # latency histograms observed once per token
+        assert f'elephas_serving_ttft_seconds_count{{engine="{eid}"}} 3' \
+            in text
+        stats = engine.stats()
+        assert stats["total_generated"] == engine.total_generated
+        assert stats["finished"] == 3
+        # prefix-cache counters ride the same registry
+        cache = engine.scheduler.prefix_cache
+        assert cache.stats()["hits"] == cache.hits
+        assert (
+            f'elephas_prefix_cache_hits_total{{cache='
+            f'"{cache.telemetry_label}"}} {cache.hits}' in text
+        )
+        # scheduler admissions: 3 total, split across kinds
+        sid = engine.scheduler.telemetry_label
+        admissions = sum(
+            int(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("elephas_serving_admissions_total")
+            and f'scheduler="{sid}"' in line
+        )
+        assert admissions == 3
+
+    def test_spark_model_scrape(self, not_null):
+        """SparkModel.scrape() renders the same process registry the
+        PS /metrics endpoint serves."""
+        from elephas_tpu import SparkModel
+        from tests.conftest import make_mlp
+
+        model = make_mlp(4, 2)
+        sm = SparkModel(model, num_workers=2)
+        marker = telemetry.registry().counter(
+            "elephas_test_spark_scrape_total", "marker"
+        )
+        marker.inc()
+        assert "elephas_test_spark_scrape_total 1" in sm.scrape()
+
+
+class TestChaosTrace:
+    def test_recovery_span_lands_on_trace_and_exports(
+        self, not_null, tmp_path
+    ):
+        """A kill→restart cycle driven by PSKiller records ONE
+        chaos.recovery span (recovered=True) whose duration is the
+        recovery window — and the Chrome export shows the kill/restart
+        instants inside it (the acceptance-criteria timeline)."""
+        from elephas_tpu.fault.harness import (
+            PSKiller,
+            RestartablePS,
+            recovery_windows_from_trace,
+        )
+        from elephas_tpu.parameter.client import SocketClient
+        from elephas_tpu.parameter.server import SocketServer
+
+        seq0 = telemetry.tracer().seq
+        ps = RestartablePS(
+            SocketServer, [np.zeros((4, 4), np.float32)],
+            journal_dir=str(tmp_path / "journal"), journal_every=1,
+        )
+        killer = PSKiller(ps, after_updates=2, restart_delay_s=0.1)
+        killer.start()
+        client = SocketClient(master=f"127.0.0.1:{ps.port}", retries=5)
+        delta = [np.full((4, 4), 0.01, np.float32)]
+        try:
+            deadline = time.monotonic() + 60
+            while ps.t_recovered is None:
+                assert time.monotonic() < deadline, "recovery not observed"
+                try:
+                    client.update_parameters(delta)
+                    client.flush()
+                except (ConnectionError, TimeoutError, OSError):
+                    pass  # fault-lint: allow chaos window, retried above
+                time.sleep(0.02)
+        finally:
+            killer.cancel()
+            killer.join(timeout=30)
+            try:
+                client.close()
+            except (ConnectionError, OSError):
+                pass  # fault-lint: allow best-effort close under chaos
+            ps.stop()
+
+        windows = recovery_windows_from_trace(since_seq=seq0)
+        assert len(windows) == 1
+        assert windows[0] >= 0.1  # at least the restart delay
+        assert windows[0] == pytest.approx(ps.recovery_s, abs=0.25)
+        names = [
+            e["name"] for e in telemetry.tracer().events(since_seq=seq0)
+        ]
+        assert "chaos.ps_kill" in names and "chaos.ps_restart" in names
+
+        path = str(tmp_path / "chaos_trace.json")
+        telemetry.tracer().export_chrome_trace(path, since_seq=seq0)
+        with open(path) as f:
+            doc = json.load(f)
+        spans = [
+            e for e in doc["traceEvents"]
+            if e["name"] == "chaos.recovery" and e["ph"] == "X"
+        ]
+        assert len(spans) == 1 and spans[0]["args"]["recovered"] is True
+        kill = next(
+            e for e in doc["traceEvents"] if e["name"] == "chaos.ps_kill"
+        )
+        # the kill instant sits inside the recovery span on the timeline
+        assert (
+            spans[0]["ts"] <= kill["ts"] <= spans[0]["ts"] + spans[0]["dur"]
+        )
+
+    def test_harness_refuses_null_mode(self, not_null):
+        from elephas_tpu.fault.harness import RestartablePS
+        from elephas_tpu.parameter.server import SocketServer
+
+        was = telemetry.set_null(True)
+        try:
+            with pytest.raises(RuntimeError, match="requires telemetry"):
+                RestartablePS(SocketServer, [np.zeros((2,), np.float32)])
+        finally:
+            telemetry.set_null(was)
+
+
+class TestWorkerRetryTelemetry:
+    def test_supervised_retry_counts_and_emits(self, not_null):
+        """A PS outage that the supervised retry rides out shows up as
+        retry counter increments and worker.retry trace events."""
+        from elephas_tpu.worker import AsynchronousSparkWorker
+
+        worker = AsynchronousSparkWorker(
+            json_model="{}", parameter_server_mode="socket",
+            ps_retries=3, ps_retry_max_delay=0.05,
+        )
+        seq0 = telemetry.tracer().seq
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("chaos")
+            return "ok"
+
+        assert worker._supervised(flaky) == "ok"
+        assert worker._m_retries.value == 2
+        events = telemetry.tracer().events(
+            since_seq=seq0, name="worker.retry"
+        )
+        assert len(events) == 2
+        assert events[0]["args"]["worker"] == worker.telemetry_label
+
+
+class TestSeriesLifecycle:
+    def test_remove_series_retires_rendering_views_survive(self):
+        """remove_series drops matching children from every family that
+        carries the label; children handed out earlier keep working
+        (retired components' read-back views must not break)."""
+        from elephas_tpu.telemetry.registry import Registry
+
+        reg = Registry()
+        a = reg.counter(
+            "elephas_t_lifecycle_total", "x", labels=("engine",)
+        ).labels(engine="a")
+        b = reg.counter(
+            "elephas_t_lifecycle_total", "x", labels=("engine",)
+        ).labels(engine="b")
+        g = reg.gauge(
+            "elephas_t_lifecycle_gauge", "x", labels=("engine",)
+        ).labels(engine="a")
+        a.inc(3)
+        b.inc(5)
+        g.set(7)
+        text = reg.render()
+        assert 'elephas_t_lifecycle_total{engine="a"} 3' in text
+        assert 'elephas_t_lifecycle_gauge{engine="a"} 7' in text
+        assert reg.remove_series(engine="a") == 2  # counter + gauge
+        text = reg.render()
+        assert 'engine="a"' not in text
+        assert 'elephas_t_lifecycle_total{engine="b"} 5' in text
+        # the retired child object itself stays live for its holder
+        a.inc()
+        assert a.value == 4
+        # re-registering the same label mints a FRESH series
+        a2 = reg.counter(
+            "elephas_t_lifecycle_total", "x", labels=("engine",)
+        ).labels(engine="a")
+        assert a2.value == 0 and a2 is not a
+
+    def test_remove_series_validation(self):
+        from elephas_tpu.telemetry.registry import NullRegistry, Registry
+
+        reg = Registry()
+        fam = reg.counter(
+            "elephas_t_val_total", "x", labels=("server",)
+        )
+        fam.labels(server="0")
+        with pytest.raises(ValueError, match="at least one label"):
+            reg.remove_series()
+        with pytest.raises(ValueError, match="cannot remove by"):
+            fam.remove(nope="0")
+        # a label no family carries is a harmless no-op
+        assert reg.remove_series(zebra="0") == 0
+        assert NullRegistry().remove_series(server="0") == 0
+
+    def test_component_release_telemetry_bounds_scrape(self, not_null):
+        """Churned components (the unbounded-growth shape: clients per
+        partition, chaos-restarted servers) retire their series via
+        release_telemetry(); scrape output stops growing and the
+        counter-backed properties keep reading."""
+        from elephas_tpu.parameter.server import SocketServer
+
+        server = SocketServer([np.zeros((4,), np.float32)], port=0)
+        server.apply_update([np.ones((4,), np.float32)], "w0", 0)
+        sid = server.telemetry_label
+        assert f'server="{sid}"' in telemetry.scrape_text()
+        server.release_telemetry()
+        text = telemetry.scrape_text()
+        assert f'server="{sid}"' not in text  # counters AND pull gauges
+        assert server.updates_applied == 1  # object-held view survives
+
+    def test_engine_release_cascades(self, not_null, serving_lm):
+        from elephas_tpu.serving import InferenceEngine
+
+        engine = InferenceEngine(serving_lm, num_slots=2, prefix_cache=True)
+        engine.run([([2, 3, 4, 5], 4)])
+        labels = (
+            f'engine="{engine.telemetry_label}"',
+            f'scheduler="{engine.scheduler.telemetry_label}"',
+            f'cache="{engine.scheduler.prefix_cache.telemetry_label}"',
+        )
+        text = telemetry.scrape_text()
+        assert all(lbl in text for lbl in labels)
+        engine.release_telemetry()
+        text = telemetry.scrape_text()
+        assert not any(lbl in text for lbl in labels)
+        assert engine.total_generated > 0  # views still read
+
+
+class TestPrefillStallSemantics:
+    def test_lone_long_prompt_never_counts_as_stalled(
+        self, not_null, serving_lm
+    ):
+        """A single long prompt consuming the whole per-step chunk
+        budget ADVANCES every step — it is not deferred, so the stall
+        counter must stay 0 (it counts slots that got NO chunk this
+        step, not slots that merely remain mid-prefill)."""
+        from elephas_tpu.serving import InferenceEngine
+
+        long_prompt = [2, 3, 4, 5] * 4  # 16 tokens = 4 chunks
+        engine = InferenceEngine(
+            serving_lm, num_slots=4, prefill_chunk=4, prefill_budget=4,
+        )
+        out = engine.run([(long_prompt, 4)])
+        assert len(out) == 1
+        assert engine._m_prefill_stalls.value == 0
+        engine.release_telemetry()
+
+    def test_concurrent_long_prompts_count_deferred_slots(
+        self, not_null, serving_lm
+    ):
+        """Two long prompts behind a one-chunk budget: each step serves
+        one slot and defers the other, so the stall counter rises."""
+        from elephas_tpu.serving import InferenceEngine
+
+        long_prompt = [2, 3, 4, 5] * 4
+        engine = InferenceEngine(
+            serving_lm, num_slots=4, prefill_chunk=4, prefill_budget=4,
+        )
+        out = engine.run([(long_prompt, 4), (list(long_prompt), 4)])
+        assert len(out) == 2
+        assert engine._m_prefill_stalls.value > 0
+        engine.release_telemetry()
