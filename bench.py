@@ -1253,6 +1253,123 @@ def measure_faults(transport: str, rows: int, epochs: int, seed: int,
     return out
 
 
+def measure_sharded_faults(transport: str, num_shards: int, rows: int,
+                           epochs: int, seed: int, standby: bool = False,
+                           trace_export: str | None = None):
+    """``--preset faults --faults-shards N`` (ISSUE 6): kill ONE shard
+    of a sharded PS mid-run and prove the acceptance criteria from the
+    run's own instrumentation — the surviving shards' ``updates_applied``
+    kept rising during the outage, the killed shard recovered from its
+    own journal with zero double-applies (per-shard applied counts match
+    the fault-free sharded run exactly, nothing lost or still parked),
+    and the per-shard recovery window read from the shard-stamped
+    ``chaos.recovery`` TRACE span agrees with the counters-side
+    kill/recovery timestamp pair."""
+    from elephas_tpu.fault.harness import (
+        measure_sharded_faults as run_sharded,
+    )
+
+    clean, faulted, plan = run_sharded(
+        transport, num_shards=num_shards, rows=rows, epochs=epochs,
+        seed=seed, standby=standby, trace_export=trace_export,
+    )
+    for name, rec in (("clean", clean), ("faulted", faulted)):
+        if not (rec["dt_s"] > MIN_CREDIBLE_DT):
+            raise ImplausibleTiming(
+                f"sharded faults {name} window {rec['dt_s']:.4f}s below "
+                f"the {MIN_CREDIBLE_DT}s credibility floor"
+            )
+    killed = faulted["killed_shard"]
+    if killed is None or not faulted["kills"][killed]:
+        raise ImplausibleTiming(
+            "shard kill never fired (training finished before the "
+            "trigger) — raise --ps-rows or lower kill_after_updates"
+        )
+    recovery = faulted["recovery_s_by_shard"].get(killed)
+    if recovery is None:
+        raise ImplausibleTiming(
+            f"shard {killed} restarted but no completed chaos.recovery "
+            f"span with shard={killed} landed on the trace stream"
+        )
+    counters_recovery = faulted["recovery_s_counters_by_shard"].get(killed)
+    if counters_recovery is None or abs(recovery - counters_recovery) > 0.5:
+        raise ImplausibleTiming(
+            f"trace recovery window {recovery!r} disagrees with the "
+            f"counters-side timestamp pair {counters_recovery!r} for "
+            f"shard {killed} — the two measure the same kill"
+        )
+    others = faulted["other_shards_progress_during_outage"] or {}
+    if not others or min(others.values()) < 1:
+        raise ImplausibleTiming(
+            f"surviving shards applied no updates during the outage "
+            f"({others!r}) — partial progress is the point of the "
+            f"sharded topology; the run cannot demonstrate it"
+        )
+    if faulted["updates_applied_by_shard"] != clean["updates_applied_by_shard"]:
+        raise ImplausibleTiming(
+            f"per-shard applied counts diverge from the fault-free run "
+            f"({faulted['updates_applied_by_shard']} vs "
+            f"{clean['updates_applied_by_shard']}) — a duplicate or a "
+            f"loss slipped through"
+        )
+    degradation = faulted["samples_per_s"] / clean["samples_per_s"]
+    log.info(
+        "sharded faults [%s, %d shards]: killed shard %d, recovery "
+        "%.2fs (trace) / %.2fs (counters), survivors progressed %s "
+        "during the outage, applied %s (== clean), %d dups sent / %s "
+        "skipped, %d resent, %d lost, degraded %.2fx",
+        transport, num_shards, killed, recovery, counters_recovery,
+        others, faulted["updates_applied_by_shard"],
+        faulted["duplicates_sent"],
+        faulted["duplicates_skipped_by_shard"],
+        faulted["updates_resent"], faulted["updates_lost_final"],
+        degradation,
+    )
+    out = {
+        "metric": (
+            f"sharded PS crash recovery ({transport}, {num_shards} "
+            f"shards, per-shard journal replay)"
+        ),
+        "value": round(recovery, 4),
+        "unit": "s",
+        "vs_baseline": round(degradation, 4),  # degraded-mode throughput
+        "num_shards": num_shards,
+        "killed_shard": killed,
+        "standby": faulted["standby"],
+        "clean_sps": round(clean["samples_per_s"], 1),
+        "faulted_sps": round(faulted["samples_per_s"], 1),
+        "recovery_s_by_shard": {
+            str(i): (None if w is None else round(w, 4))
+            for i, w in faulted["recovery_s_by_shard"].items()
+        },
+        "recovery_s_counters_by_shard": {
+            str(i): (None if w is None else round(w, 4))
+            for i, w in faulted["recovery_s_counters_by_shard"].items()
+        },
+        "other_shards_progress_during_outage": {
+            str(i): n for i, n in others.items()
+        },
+        "restart_delay_s": plan.restart_delay_s,
+        "updates_applied_by_shard": faulted["updates_applied_by_shard"],
+        "updates_expected_by_shard": clean["updates_applied_by_shard"],
+        "duplicates_sent": faulted["duplicates_sent"],
+        "duplicates_skipped_by_shard": faulted[
+            "duplicates_skipped_by_shard"
+        ],
+        "updates_resent": faulted["updates_resent"],
+        "updates_lost_final": faulted["updates_lost_final"],
+        "pending_final": faulted["pending_final"],
+        "kills": faulted["kills"],
+        "restarts": faulted["restarts"],
+        "seed": seed,
+        "rows": rows,
+        "epochs": epochs,
+    }
+    if trace_export:
+        out["trace_export"] = trace_export
+    return out
+
+
 def measure_keras_fit(model, x, y, batch_size, epochs):
     """Stock keras ``model.fit`` images/sec (the glue-path floor only —
     numpy fed per batch; NOT the honest baseline)."""
@@ -1284,6 +1401,16 @@ def main():
                    help="faults preset: export the chaos run's events "
                         "(kill, restart, recovery span, worker retries, "
                         "PS round-trips) as Chrome-trace JSON here")
+    p.add_argument("--faults-shards", type=int, default=1,
+                   help="faults preset: shard the PS across N servers "
+                        "and kill ONE shard — reports per-shard "
+                        "recovery windows from shard-stamped trace "
+                        "spans plus the surviving shards' progress "
+                        "during the outage (ISSUE 6)")
+    p.add_argument("--faults-standby", action="store_true",
+                   help="faults preset (sharded): hot-standby mode — a "
+                        "watcher restarts the killed shard instead of "
+                        "the killer thread")
     p.add_argument("--ps-transport", choices=["socket", "http"],
                    default="socket",
                    help="ps preset: which server/client pair to measure")
@@ -1370,16 +1497,28 @@ def main():
         return
 
     if args.preset == "faults":
-        # loopback chaos run (ISSUE 3) — like ps, no mesh and no TPU
-        # probe; reuses the --ps-rows/--ps-epochs/--ps-transport knobs
+        # loopback chaos run (ISSUE 3; sharded topology ISSUE 6) — like
+        # ps, no mesh and no TPU probe; reuses the --ps-rows/--ps-epochs/
+        # --ps-transport knobs
         try:
-            out = measure_faults(
-                args.ps_transport,
-                max(128, args.ps_rows),
-                max(1, args.ps_epochs),
-                args.faults_seed,
-                trace_export=args.faults_trace,
-            )
+            if args.faults_shards > 1:
+                out = measure_sharded_faults(
+                    args.ps_transport,
+                    args.faults_shards,
+                    max(128, args.ps_rows),
+                    max(1, args.ps_epochs),
+                    args.faults_seed,
+                    standby=args.faults_standby,
+                    trace_export=args.faults_trace,
+                )
+            else:
+                out = measure_faults(
+                    args.ps_transport,
+                    max(128, args.ps_rows),
+                    max(1, args.ps_epochs),
+                    args.faults_seed,
+                    trace_export=args.faults_trace,
+                )
         except ImplausibleTiming as e:
             log.error("faults bench implausible: %s — no JSON", e)
             sys.exit(1)

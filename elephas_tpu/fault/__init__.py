@@ -27,6 +27,11 @@ from elephas_tpu.fault.plan import (  # noqa: F401
 from elephas_tpu.fault.harness import (  # noqa: F401
     PSKiller,
     RestartablePS,
+    ShardKiller,
+    ShardedRestartablePS,
     measure_faults,
+    measure_sharded_faults,
     run_chaos_training,
+    run_elastic_membership,
+    run_sharded_chaos_training,
 )

@@ -48,18 +48,23 @@ def _require_telemetry(what: str) -> None:
         )
 
 
-def recovery_windows_from_trace(tracer=None, since_seq: int = 0) -> list:
+def recovery_windows_from_trace(
+    tracer=None, since_seq: int = 0, shard: int | None = None
+) -> list:
     """Kill→first-post-restart-apply windows (seconds) read from the
-    trace stream — the ``chaos.recovery`` spans :class:`PSKiller`
-    records, filtered to those that actually observed recovery. This is
-    what ``bench.py --preset faults`` reports (ISSUE 5 satellite: the
-    bench reads the same stream an operator's trace viewer shows, not
-    bespoke harness counters)."""
+    trace stream — the ``chaos.recovery`` spans :class:`PSKiller` /
+    :class:`ShardKiller` record, filtered to those that actually
+    observed recovery. With ``shard`` set, only that shard's spans
+    (the ``shard`` arg the sharded killer stamps) are returned — how
+    ``bench.py --preset faults --faults-shards N`` reports per-shard
+    windows (ISSUE 5/6: the bench reads the same stream an operator's
+    trace viewer shows, not bespoke harness counters)."""
     tracer = tracer or telemetry.tracer()
     return [
         float(e["dur"])
         for e in tracer.events(since_seq=since_seq, name="chaos.recovery")
         if e["args"].get("recovered")
+        and (shard is None or e["args"].get("shard") == int(shard))
     ]
 
 
@@ -219,6 +224,560 @@ class PSKiller(threading.Thread):
             span.set(recovered=recovered)
         if recovered:
             self.ps.t_recovered = time.monotonic()
+
+
+# -- sharded chaos (ISSUE 6) ---------------------------------------------
+
+
+class ShardedRestartablePS:
+    """N per-shard restartable servers — the sharded sibling of
+    :class:`RestartablePS`: each shard can be crash-killed (no terminal
+    journal flush) and restarted on its original port, replaying ONLY
+    its own journal (``journal_dir/shard-<i>/``).
+
+    **Hot-standby mode** (``standby_delay_s``): a daemon watcher
+    restarts any killed shard automatically after the delay — the
+    kill/restart decision decouples from whoever killed it (the
+    production shape: a supervisor reschedules the dead shard while
+    clients park that slice's sequenced pushes and resend on return).
+
+    Counters accumulate across incarnations per shard, so callers read
+    totals — and can read the OTHER shards' totals mid-outage, which is
+    the partial-progress evidence the acceptance criteria ask for.
+    """
+
+    def __init__(
+        self,
+        server_cls,
+        weights,
+        num_shards: int,
+        mode: str = "asynchronous",
+        journal_dir: str | None = None,
+        journal_every: int = 2,
+        lease_timeout: float = 30.0,
+        standby_delay_s: float | None = None,
+        host: str = "127.0.0.1",
+    ):
+        from elephas_tpu.parameter.sharding import (
+            ShardMap,
+            shard_journal_dir,
+        )
+
+        _require_telemetry("ShardedRestartablePS")
+        self._server_cls = server_cls
+        self.shard_map = ShardMap.from_weights(weights, num_shards)
+        self._slices = self.shard_map.scatter(
+            [np.asarray(w) for w in weights]
+        )
+        self._mode = mode
+        self._journal_dirs = [
+            shard_journal_dir(journal_dir, i) if journal_dir else None
+            for i in range(num_shards)
+        ]
+        self._journal_every = journal_every
+        self._lease_timeout = lease_timeout
+        self.host = host
+        self.num_shards = num_shards
+        self.kills = [0] * num_shards
+        self.restarts = [0] * num_shards
+        # per-shard kill/recovery timestamps — the counters-side
+        # cross-check for the trace-span recovery windows (PR 5 shape)
+        self.t_killed: list[float | None] = [None] * num_shards
+        self.t_recovered: list[float | None] = [None] * num_shards
+        self._dead_counts = [
+            {"updates_applied": 0, "updates_duplicate": 0}
+            for _ in range(num_shards)
+        ]
+        self._lock = threading.Lock()
+        self.servers: list = [None] * num_shards
+        for i in range(num_shards):
+            self.servers[i] = self._spawn(i, port=0)
+            self.servers[i].start()
+        self.ports = [s.port for s in self.servers]
+        self._standby_delay = standby_delay_s
+        self._standby_stop = threading.Event()
+        self._standby = None
+        if standby_delay_s is not None:
+            self._standby = threading.Thread(
+                target=self._standby_loop,
+                name="elephas-chaos-shard-standby", daemon=True,
+            )
+            self._standby.start()
+
+    def _spawn(self, shard: int, port: int):
+        return self._server_cls(
+            self._slices[shard],
+            mode=self._mode,
+            port=port,
+            journal_dir=self._journal_dirs[shard],
+            journal_every=self._journal_every,
+            lease_timeout=self._lease_timeout,
+            shard_id=shard,
+            num_shards=self.num_shards,
+            shard_signature=self.shard_map.signature(),
+        )
+
+    @property
+    def endpoints(self) -> str:
+        return ",".join(f"{self.host}:{p}" for p in self.ports)
+
+    def kill(self, shard: int) -> None:
+        """Crash shard ``shard``: sever its connections, skip the
+        terminal journal flush (recovery must replay the last periodic
+        snapshot — the honest crash case)."""
+        with self._lock:
+            server, self.servers[shard] = self.servers[shard], None
+        if server is None:
+            return
+        self.t_killed[shard] = time.monotonic()
+        self.kills[shard] += 1
+        telemetry.emit(
+            "chaos.ps_kill", port=self.ports[shard], shard=shard,
+            kills=self.kills[shard],
+        )
+        server.stop(flush_journal=False)
+        # absorb AFTER stop: an op in flight at the kill may still
+        # complete its apply while connections sever
+        self._absorb(shard, server)
+        logger.info(
+            "chaos: shard %d killed on port %d", shard, self.ports[shard]
+        )
+
+    def restart(self, shard: int) -> None:
+        server = self._spawn(shard, port=self.ports[shard])
+        server.start()
+        with self._lock:
+            self.servers[shard] = server
+        self.restarts[shard] += 1
+        telemetry.emit(
+            "chaos.ps_restart", port=self.ports[shard], shard=shard,
+            journal_restored=server.restored_from_journal,
+        )
+        logger.info(
+            "chaos: shard %d restarted on port %d (journal restored: "
+            "%s)", shard, self.ports[shard],
+            server.restored_from_journal,
+        )
+
+    def _standby_loop(self) -> None:
+        """Hot standby: bring any killed shard back after the delay."""
+        while not self._standby_stop.is_set():
+            for i in range(self.num_shards):
+                if self.servers[i] is None and self.kills[i] > self.restarts[i]:
+                    if self._standby_stop.wait(self._standby_delay):
+                        return
+                    if self.servers[i] is None:
+                        self.restart(i)
+            self._standby_stop.wait(0.01)
+
+    def _absorb(self, shard: int, server) -> None:
+        self._dead_counts[shard]["updates_applied"] += server.updates_applied
+        self._dead_counts[shard]["updates_duplicate"] += (
+            server.updates_duplicate
+        )
+
+    def shard_counters(self, shard: int) -> dict[str, int]:
+        out = dict(self._dead_counts[shard])
+        server = self.servers[shard]
+        if server is not None:
+            out["updates_applied"] += server.updates_applied
+            out["updates_duplicate"] += server.updates_duplicate
+        return out
+
+    def counters(self) -> dict[str, int]:
+        totals = {"updates_applied": 0, "updates_duplicate": 0}
+        for i in range(self.num_shards):
+            for k, v in self.shard_counters(i).items():
+                totals[k] += v
+        return totals
+
+    def get_parameters(self, timeout_s: float = 30.0):
+        """Gather the full weight list. A shard awaiting its hot-standby
+        restart is waited for (bounded) rather than crashing a caller
+        who raced the watcher; a dead shard nobody will restart is a
+        loud error, not an AttributeError on ``None``."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                servers = list(self.servers)
+            down = [i for i, s in enumerate(servers) if s is None]
+            if not down:
+                return self.shard_map.gather(
+                    [s.get_parameters() for s in servers]
+                )
+            if self._standby is None or time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"shard(s) {down} are killed and not restarted — "
+                    f"cannot gather the full weight list (restart them, "
+                    f"or run hot standby and retry)"
+                )
+            time.sleep(0.01)
+
+    def stop(self) -> None:
+        self._standby_stop.set()
+        if self._standby is not None:
+            self._standby.join(timeout=10)
+        for i, server in enumerate(self.servers):
+            if server is not None:
+                self._absorb(i, server)
+                server.stop()
+                self.servers[i] = None
+
+
+class ShardKiller(threading.Thread):
+    """Kills ONE shard once it has applied ``after_updates`` more
+    updates (beyond ``baseline``), then waits for its recovery —
+    restarting it itself after ``restart_delay_s`` unless the
+    :class:`ShardedRestartablePS` runs hot standby (then the standby
+    owns the restart and this thread only observes). The
+    kill→first-post-restart-apply window lands as ONE
+    ``chaos.recovery`` span stamped with ``shard=``, and the OTHER
+    shards' applied counts are snapshotted at kill and at recovery —
+    ``other_progress`` is the partial-progress proof."""
+
+    def __init__(
+        self,
+        ps: ShardedRestartablePS,
+        shard: int,
+        after_updates: int,
+        restart_delay_s: float = 0.5,
+        baseline: int = 0,
+        poll_s: float = 0.01,
+    ):
+        super().__init__(name="elephas-chaos-shardkiller", daemon=True)
+        self.ps = ps
+        self.shard = int(shard)
+        self.after_updates = int(after_updates)
+        self.restart_delay_s = float(restart_delay_s)
+        self.baseline = int(baseline)
+        self.poll_s = float(poll_s)
+        self.other_progress: dict[int, int] | None = None
+        self.recovered = False
+        self._cancel = threading.Event()
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    def _applied(self) -> int:
+        return self.ps.shard_counters(self.shard)["updates_applied"]
+
+    def _wait_applied(self, threshold: int) -> bool:
+        while not self._cancel.is_set():
+            if self._applied() >= threshold:
+                return True
+            time.sleep(self.poll_s)
+        return False
+
+    def _wait_reborn_applied(self) -> bool:
+        # Recovery = the REBORN incarnation's OWN first apply (its
+        # counter starts at zero; the journal meta is informational).
+        # Waiting on the absorbed per-shard total instead would race:
+        # an apply in flight at the kill still lands while connections
+        # sever and is absorbed into the dead counts, satisfying an
+        # at-kill+1 threshold with no post-restart apply at all — and
+        # the trace-vs-counters cross-check could not catch it, both
+        # sides deriving from the same too-early event.
+        while not self._cancel.is_set():
+            server = self.ps.servers[self.shard]
+            if server is not None and server.updates_applied >= 1:
+                return True
+            time.sleep(self.poll_s)
+        return False
+
+    def _others(self) -> dict[int, int]:
+        return {
+            i: self.ps.shard_counters(i)["updates_applied"]
+            for i in range(self.ps.num_shards)
+            if i != self.shard
+        }
+
+    def run(self) -> None:
+        if not self._wait_applied(self.baseline + self.after_updates):
+            return
+        standby = self.ps._standby is not None
+        with telemetry.trace_span(
+            "chaos.recovery", shard=self.shard,
+            port=self.ps.ports[self.shard],
+            after_updates=self.after_updates,
+            restart_delay_s=self.restart_delay_s,
+            standby=standby,
+        ) as span:
+            others_at_kill = self._others()
+            self.ps.kill(self.shard)
+            if not standby:
+                time.sleep(self.restart_delay_s)
+                self.ps.restart(self.shard)
+            # recovery = the REBORN shard applies (resent/parked
+            # updates land); under standby the restart itself is the
+            # watcher's, we only observe
+            self.recovered = self._wait_reborn_applied()
+            span.set(recovered=self.recovered)
+        if self.recovered:
+            self.ps.t_recovered[self.shard] = time.monotonic()
+            self.other_progress = {
+                i: n - others_at_kill[i]
+                for i, n in self._others().items()
+            }
+
+
+def run_sharded_chaos_training(
+    transport: str = "socket",
+    num_shards: int = 2,
+    rows: int = 256,
+    epochs: int = 2,
+    batch_size: int = 64,
+    seed: int = 0,
+    plan: FaultPlan | None = None,
+    journal_dir: str | None = None,
+    journal_every: int = 1,
+    mode: str = "asynchronous",
+    ps_retries: int = 8,
+    standby: bool = False,
+    trace_export: str | None = None,
+) -> dict:
+    """One real async-worker run against a SHARDED restartable PS —
+    the multi-shard sibling of :func:`run_chaos_training`, shared by
+    ``tests/test_ps_sharding.py`` and ``bench.py --preset faults
+    --faults-shards N``.
+
+    Under a plan with ``kill_ps_after_updates``, shard
+    ``plan.kill_shard`` is crash-killed mid-run and recovers from its
+    own journal (hot standby when ``standby=True``); the worker's
+    sharded client parks that slice's pushes and keeps the other
+    shards served. Returns per-shard counters, the per-shard recovery
+    window read from the shard-stamped ``chaos.recovery`` trace span,
+    and ``other_shards_progress_during_outage`` — updates the
+    surviving shards applied inside the recovery window (the
+    acceptance criterion's partial-progress proof).
+    """
+    from elephas_tpu.parameter.server import HttpServer, SocketServer
+    from elephas_tpu.worker import AsynchronousSparkWorker
+
+    _require_telemetry("run_sharded_chaos_training")
+    trace_seq0 = telemetry.tracer().seq
+    x, y, d, k = _chaos_data(seed, rows)
+    model = _chaos_model(seed, d, k)
+    server_cls = {"socket": SocketServer, "http": HttpServer}[transport]
+    plan = plan or FaultPlan(seed=seed)
+    ps = ShardedRestartablePS(
+        server_cls,
+        model.get_weights(),
+        num_shards,
+        mode=mode,
+        journal_dir=journal_dir,
+        journal_every=journal_every,
+        standby_delay_s=plan.restart_delay_s if standby else None,
+    )
+    worker = AsynchronousSparkWorker(
+        model.to_json(),
+        train_config={"epochs": epochs, "batch_size": batch_size},
+        frequency="batch",
+        parameter_server_mode=transport,
+        master=ps.endpoints,
+        master_optimizer="adam",
+        master_loss="sparse_categorical_crossentropy",
+        ps_retries=ps_retries,
+    )
+    clients: list = []
+    real_client = worker._client
+
+    def chaotic_client(model=None):
+        client = real_client(model)
+        if plan.duplicate_fraction > 0.0:
+            client.chaos_duplicate = plan.duplicate
+        clients.append(client)
+        return client
+
+    worker._client = chaotic_client
+
+    killer = None
+    try:
+        # warmup outside the timed window and before any chaos
+        list(worker.train(iter(zip(x[:batch_size], y[:batch_size]))))
+        baseline = ps.shard_counters(plan.kill_shard)["updates_applied"]
+        if plan.kill_ps_after_updates is not None:
+            killer = ShardKiller(
+                ps,
+                plan.kill_shard,
+                plan.kill_ps_after_updates,
+                restart_delay_s=plan.restart_delay_s,
+                baseline=baseline,
+            )
+            killer.start()
+        t0 = time.perf_counter()
+        list(worker.train(iter(zip(x, y))))
+        dt = time.perf_counter() - t0
+    finally:
+        if killer is not None:
+            killer.cancel()
+            killer.join(timeout=30)
+    try:
+        per_shard = [ps.shard_counters(i) for i in range(num_shards)]
+        final_weights = ps.get_parameters()
+    finally:
+        ps.stop()
+
+    shard_windows = {
+        i: recovery_windows_from_trace(since_seq=trace_seq0, shard=i)
+        for i in range(num_shards)
+    }
+    if trace_export:
+        n_events = telemetry.tracer().export_chrome_trace(
+            trace_export, since_seq=trace_seq0
+        )
+        logger.info(
+            "sharded chaos trace: %d events exported to %s",
+            n_events, trace_export,
+        )
+    killed = plan.kill_shard
+    return {
+        "transport": transport,
+        "num_shards": num_shards,
+        "rows": rows,
+        "epochs": epochs,
+        "seed": seed,
+        "dt_s": dt,
+        "samples_per_s": rows * epochs / dt,
+        "killed_shard": killed if plan.kill_ps_after_updates else None,
+        "kills": list(ps.kills),
+        "restarts": list(ps.restarts),
+        "standby": standby,
+        "recovery_s_by_shard": {
+            i: (w[-1] if w else None) for i, w in shard_windows.items()
+        },
+        # counters-side cross-check (kill/recovery timestamp pair per
+        # shard) for the trace-span windows above
+        "recovery_s_counters_by_shard": {
+            i: (
+                None
+                if ps.t_killed[i] is None or ps.t_recovered[i] is None
+                else ps.t_recovered[i] - ps.t_killed[i]
+            )
+            for i in range(num_shards)
+        },
+        "updates_applied_by_shard": [
+            c["updates_applied"] for c in per_shard
+        ],
+        "duplicates_skipped_by_shard": [
+            c["updates_duplicate"] for c in per_shard
+        ],
+        "other_shards_progress_during_outage": (
+            killer.other_progress if killer is not None else None
+        ),
+        "updates_resent": sum(c.updates_resent for c in clients),
+        "duplicates_sent": sum(c.chaos_dups_sent for c in clients),
+        "pending_final": [
+            n for c in clients
+            for n in getattr(c, "pending_counts", [])
+        ],
+        "updates_lost_final": sum(
+            getattr(c, "updates_lost", 0) for c in clients
+        ),
+        "final_weights": final_weights,
+        "data": (x, y),
+    }
+
+
+def run_elastic_membership(
+    transport: str = "socket",
+    num_shards: int = 2,
+    rows: int = 192,
+    batch_size: int = 32,
+    seed: int = 0,
+    join_after_periods: int = 2,
+    journal_dir: str | None = None,
+) -> dict:
+    """Elastic data-parallel membership against a (sharded) PS: one
+    worker runs the whole dataset, a second LEAVES mid-run (it trains
+    only a head slice, flushes, closes — its lease then goes stale),
+    and a third JOINS mid-run (starts after the early worker's
+    departure, pulls the then-current weights, contributes the tail).
+    No coordinator round-trip anywhere: registration is implicit in
+    the first sequenced update and departure is just lease staleness —
+    the PR 3 membership machinery carrying elastic DP (ISSUE 6).
+
+    Returns the final per-shard membership view, applied/duplicate
+    totals, and the final weights for convergence assertions.
+    """
+    from elephas_tpu.parameter.server import HttpServer, SocketServer
+    from elephas_tpu.worker import AsynchronousSparkWorker
+
+    _require_telemetry("run_elastic_membership")
+    x, y, d, k = _chaos_data(seed, rows)
+    model = _chaos_model(seed, d, k)
+    server_cls = {"socket": SocketServer, "http": HttpServer}[transport]
+    ps = ShardedRestartablePS(
+        server_cls, model.get_weights(), num_shards,
+        journal_dir=journal_dir,
+    )
+
+    def make_worker(client_id: str):
+        return AsynchronousSparkWorker(
+            model.to_json(),
+            train_config={"epochs": 1, "batch_size": batch_size},
+            frequency="batch",
+            parameter_server_mode=transport,
+            master=ps.endpoints,
+            master_optimizer="adam",
+            master_loss="sparse_categorical_crossentropy",
+            client_id=client_id,
+        )
+
+    third = rows // 3
+    joined = threading.Event()
+    errors: list = []
+
+    def steady():
+        try:
+            list(make_worker("steady").train(iter(zip(x, y))))
+        except BaseException as e:  # surfaced below, never swallowed
+            errors.append(("steady", e))
+
+    def leaver():
+        try:
+            # trains only the head slice then closes: a mid-run
+            # departure — flush() inside train() confirms delivery
+            # first, so nothing it pushed is lost
+            list(make_worker("leaver").train(
+                iter(zip(x[:third], y[:third]))
+            ))
+        except BaseException as e:
+            errors.append(("leaver", e))
+        finally:
+            joined.set()  # the joiner enters once the leaver is gone
+
+    def joiner():
+        joined.wait(timeout=60)
+        try:
+            list(make_worker("joiner").train(
+                iter(zip(x[third:], y[third:]))
+            ))
+        except BaseException as e:
+            errors.append(("joiner", e))
+
+    threads = [
+        threading.Thread(target=fn, daemon=True, name=f"elastic-{fn.__name__}")
+        for fn in (steady, leaver, joiner)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        if errors:
+            raise RuntimeError(f"elastic workers failed: {errors!r}")
+        members = [s.members() for s in ps.servers]
+        counters = ps.counters()
+        final_weights = ps.get_parameters()
+    finally:
+        ps.stop()
+    return {
+        "members_by_shard": members,
+        "updates_applied": counters["updates_applied"],
+        "updates_duplicate": counters["updates_duplicate"],
+        "final_weights": final_weights,
+        "data": (x, y),
+    }
 
 
 # -- end-to-end chaos training -------------------------------------------
@@ -432,6 +991,61 @@ def measure_faults(
             seed=seed,
             plan=plan,
             journal_dir=jdir,
+            trace_export=trace_export,
+        )
+    return clean, faulted, plan
+
+
+def measure_sharded_faults(
+    transport: str = "socket",
+    num_shards: int = 2,
+    rows: int = 256,
+    epochs: int = 2,
+    batch_size: int = 64,
+    seed: int = 0,
+    kill_after_updates: int | None = None,
+    restart_delay_s: float = 0.75,
+    duplicate_fraction: float = 0.25,
+    kill_shard: int = 0,
+    standby: bool = False,
+    trace_export: str | None = None,
+):
+    """``bench.py --preset faults --faults-shards N`` backend (ISSUE
+    6): one fault-free SHARDED run and one chaos run on the same
+    seeded data/model, where only shard ``kill_shard`` is crash-killed
+    mid-run (plus a seeded fraction of duplicated update frames on
+    every shard) and recovers from its own journal. Returns
+    ``(clean, faulted, plan)``; the caller owns the JSON contract and
+    the credibility gates (per-shard trace-vs-counters agreement,
+    surviving-shard progress, exactly-once totals)."""
+    clean = run_sharded_chaos_training(
+        transport, num_shards=num_shards, rows=rows, epochs=epochs,
+        batch_size=batch_size, seed=seed, plan=None,
+    )
+    if kill_after_updates is None:
+        # land the kill mid-epoch, around a third into the sync stream
+        # (every sync period touches every shard, so per-shard applied
+        # counts track the period count)
+        periods = max(1, -(-rows // batch_size)) * epochs
+        kill_after_updates = max(2, periods // 3)
+    plan = FaultPlan(
+        seed=seed,
+        kill_ps_after_updates=kill_after_updates,
+        restart_delay_s=restart_delay_s,
+        duplicate_fraction=duplicate_fraction,
+        kill_shard=kill_shard,
+    )
+    with tempfile.TemporaryDirectory(prefix="elephas-shard-faults-") as jdir:
+        faulted = run_sharded_chaos_training(
+            transport,
+            num_shards=num_shards,
+            rows=rows,
+            epochs=epochs,
+            batch_size=batch_size,
+            seed=seed,
+            plan=plan,
+            journal_dir=jdir,
+            standby=standby,
             trace_export=trace_export,
         )
     return clean, faulted, plan

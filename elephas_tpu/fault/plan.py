@@ -72,6 +72,7 @@ class FaultPlan:
         duplicate_fraction: float = 0.0,
         failed_partitions: tuple[int, ...] = (),
         socket_faults: SocketFaults | None = None,
+        kill_shard: int = 0,
     ):
         if not 0.0 <= duplicate_fraction <= 1.0:
             raise ValueError(
@@ -84,6 +85,10 @@ class FaultPlan:
         self.duplicate_fraction = float(duplicate_fraction)
         self.failed_partitions = tuple(int(i) for i in failed_partitions)
         self.socket_faults = socket_faults
+        # ISSUE 6: which shard of a sharded PS topology the kill
+        # targets (ignored by the single-PS harness, where the one
+        # server is implicitly shard 0)
+        self.kill_shard = int(kill_shard)
 
     # -- per-event decisions (order-independent, seeded) ---------------
 

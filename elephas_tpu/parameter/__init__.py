@@ -21,6 +21,13 @@ idempotent via client-assigned sequence IDs
 (:mod:`elephas_tpu.parameter.journal`; protocol version 2 adds the
 sequenced-update, heartbeat, and status ops), turning the clients'
 at-least-once retries into effectively-once delivery.
+
+ISSUE 6 shards the key space: :mod:`elephas_tpu.parameter.sharding`
+maps weight tensors deterministically onto N PS endpoints
+(:class:`ShardMap`, :class:`ShardedServerGroup` with per-shard
+journals), and :class:`ShardedClient` scatter/gathers pushes and pulls
+across them with per-shard sequence IDs and partial-failure isolation
+— one dead shard pauses only its slice.
 """
 
 from elephas_tpu.parameter.server import (  # noqa: F401
@@ -31,13 +38,21 @@ from elephas_tpu.parameter.server import (  # noqa: F401
 from elephas_tpu.parameter.client import (  # noqa: F401
     BaseParameterClient,
     HttpClient,
+    ShardedClient,
     SocketClient,
+)
+from elephas_tpu.parameter.sharding import (  # noqa: F401
+    ShardMap,
+    ShardedServerGroup,
+    shard_endpoints,
+    shard_journal_dir,
 )
 from elephas_tpu.parameter.codec import (  # noqa: F401
     ErrorFeedback,
     WireCodec,
 )
 from elephas_tpu.parameter.journal import (  # noqa: F401
+    clean_orphaned_tmp,
     load_journal,
     save_journal,
 )

@@ -211,6 +211,23 @@ class BaseParameterClient:
     def update_parameters(self, delta) -> None:
         raise NotImplementedError
 
+    # -- sharded scatter/gather hooks (ISSUE 6) ------------------------
+    # The sharded client must encode ONCE and own the (seq, body) pair
+    # across pause/resend cycles — a re-encode would re-absorb the
+    # error-feedback residual and a re-assigned seq would break the
+    # server-side dedup ordering.
+
+    def prepare_push(self, delta) -> tuple[int | None, bytes]:
+        """Encode one push and assign its sequence ID (None when this
+        connection is unsequenced — such pushes must never be buffered
+        for resend, a replay could double-apply)."""
+        raise NotImplementedError
+
+    def push_encoded(self, seq: int | None, body: bytes) -> None:
+        """Send an already-prepared push (idempotent to retry when
+        ``seq`` is not None — the server dedups)."""
+        raise NotImplementedError
+
 
 class HttpClient(BaseParameterClient):
     def __init__(
@@ -351,6 +368,21 @@ class HttpClient(BaseParameterClient):
         # locally-decoded frames — so the error-feedback residual
         # (absorbed at encode time) stays exact.
         self._legacy_update(pickle.dumps(wire.decode(body)))
+
+    def prepare_push(self, delta) -> tuple[int | None, bytes]:
+        # A sequence ID is a promise of dedup-protected replay (the
+        # sharded client parks and replays only sequenced pushes). A
+        # known-legacy server ignores the sequence headers, so hand
+        # back seq=None — the park path then refuses to buffer instead
+        # of replaying an update the server would apply twice.
+        body = self._encode_update(delta)
+        if self._binary is False:
+            return None, body
+        return self._next_seq(), body
+
+    def push_encoded(self, seq: int | None, body: bytes) -> None:
+        with self._tracer.span("ps.push", client=self.telemetry_label):
+            self._retry(lambda: self._update_once(body, seq))
 
     def _post_update_bin(self, body: bytes, seq: int | None) -> bool | None:
         """POST /update.bin once. Returns applied?, or None on a 404
@@ -651,6 +683,24 @@ class SocketClient(BaseParameterClient):
         # legacy-pickle fallback path
         self._m_bytes_sent.inc(sockets.send(self._sock, delta))
 
+    def prepare_push(self, delta) -> tuple[int | None, bytes]:
+        if not self._binary:
+            raise ConnectionError(
+                "sharded pushes need the binary protocol; this "
+                "connection negotiated the legacy pickle wire"
+            )
+        seq = self._next_seq() if self._sequenced else None
+        return seq, self._encode_update(delta)
+
+    def push_encoded(self, seq: int | None, body: bytes) -> None:
+        if not self._binary:
+            raise ConnectionError(
+                "sharded pushes need the binary protocol; this "
+                "connection negotiated the legacy pickle wire"
+            )
+        with self._tracer.span("ps.push", client=self.telemetry_label):
+            self._retry(lambda: self._push_once(seq, body))
+
     # -- liveness (ISSUE 3) -------------------------------------------
 
     def flush(self) -> None:
@@ -727,3 +777,344 @@ class SocketClient(BaseParameterClient):
                     in_doubt, e, self.updates_lost,
                 )
         self._close_sock()
+
+
+# -- sharded scatter/gather client (ISSUE 6 tentpole, part 2) ------------
+
+
+_WIRE_ERRORS = (ConnectionError, TimeoutError, OSError)
+
+# a paused shard may buffer at most this many prepared pushes; beyond
+# it the push raises (backpressure into the worker's supervised retry)
+# instead of letting a long outage buffer unbounded encoded deltas
+MAX_SHARD_PENDING = 64
+
+
+class ShardedClient:
+    """Scatter/gather client over N per-shard parameter servers.
+
+    One logical ``get_parameters``/``update_parameters`` surface (the
+    exact :class:`BaseParameterClient` contract the workers drive),
+    fanned across the shard topology a
+    :class:`~elephas_tpu.parameter.sharding.ShardMap` defines. Each
+    shard gets its own inner transport client sharing this worker's
+    ``client_id`` but keeping an **independent sequence counter** — the
+    per-shard servers each hold their own ``(client, seq)`` dedup
+    table, so effectively-once holds per shard (there is NO cross-shard
+    ordering guarantee; see docs/API.md).
+
+    **Partial-failure isolation**: a push whose shard is unreachable
+    (even after the inner client's reconnect retries) is parked —
+    encoded once, sequence ID already assigned — in that shard's
+    bounded pending queue and replayed IN ORDER when the shard returns
+    (out-of-order delivery would be mis-deduplicated: the server skips
+    any seq at or below the last applied). Other shards keep serving;
+    only the dead shard's slice pauses. A pull against a dead shard
+    falls back to that shard's last successfully pulled slice (stale,
+    Hogwild-style — counted loudly) so training on the live slices
+    continues. ``flush()`` is the strict path: it replays every pending
+    push and confirms delivery on every shard, raising if any shard is
+    still down — the worker calls it (under supervised retry) before
+    reporting a partition done.
+    """
+
+    def __init__(
+        self,
+        master,
+        shard_map,
+        transport: str = "socket",
+        client_id: str | None = None,
+        validate: bool = True,
+        **client_kwargs,
+    ):
+        from elephas_tpu.parameter.sharding import shard_endpoints
+
+        endpoints = (
+            shard_endpoints(master) if isinstance(master, str)
+            else list(master)
+        )
+        if len(endpoints) != shard_map.num_shards:
+            raise ValueError(
+                f"shard map expects {shard_map.num_shards} shards but "
+                f"got {len(endpoints)} endpoint(s) {endpoints!r} — a "
+                f"mis-sized endpoint list would silently cross-wire "
+                f"tensor slices"
+            )
+        cls = {"http": HttpClient, "socket": SocketClient}.get(transport)
+        if cls is None:
+            raise ValueError(
+                f"transport must be 'http' or 'socket', got {transport!r}"
+            )
+        self.shard_map = shard_map
+        self.client_id = client_id or default_client_id()
+        # every inner client shares the worker identity; sequence
+        # counters stay per-inner (= per-shard), matching the per-shard
+        # server dedup tables
+        self._parts = [
+            cls(master=e, client_id=self.client_id, **client_kwargs)
+            for e in endpoints
+        ]
+        self.endpoints = endpoints
+        self._pending: list[deque[tuple[int, bytes]]] = [
+            deque() for _ in endpoints
+        ]
+        # last successfully pulled slice per shard — the stale fallback
+        # a dead shard's pull serves so live slices keep training
+        self._last_slice: list[list | None] = [None] * len(endpoints)
+
+        reg = telemetry.registry()
+        label = telemetry.instance_label()
+        self.telemetry_label = label
+        self._tracer = telemetry.tracer()
+        self._m_shard_pauses = reg.counter(
+            "elephas_ps_client_shard_pauses_total",
+            "Pushes parked because their shard was unreachable",
+            labels=("client", "shard"),
+        )
+        self._m_stale_pulls = reg.counter(
+            "elephas_ps_client_shard_stale_pulls_total",
+            "Pulls served from a dead shard's last-known slice",
+            labels=("client", "shard"),
+        )
+        if validate:
+            self.validate_topology()
+
+    # -- topology validation (ISSUE 6 satellite) -----------------------
+
+    def validate_topology(self) -> None:
+        """Cross-check every server's self-reported shard identity
+        against this client's map — fail fast on mis-wiring (shard 0's
+        endpoint actually serving shard 1 would scatter slices into the
+        wrong dedup tables and journals). Servers that predate shard
+        identity (plain v2) or the status op (legacy v1) report
+        nothing; absence is tolerated with a warning — only a
+        CONFLICTING identity is fatal."""
+        n = self.shard_map.num_shards
+        for i, inner in enumerate(self._parts):
+            try:
+                st = inner.status()
+            except _WIRE_ERRORS as e:
+                raise ConnectionError(
+                    f"shard {i} ({self.endpoints[i]}) failed topology "
+                    f"validation — no status op (legacy server, or "
+                    f"down): {e!r}; sharded topologies need protocol-2 "
+                    f"servers"
+                ) from e
+            sid, num = st.get("shard_id"), st.get("num_shards")
+            if sid is None and num is None:
+                logger.warning(
+                    "shard %d (%s) reports no shard identity — cannot "
+                    "verify the topology (server started without "
+                    "shard_id/num_shards?)", i, self.endpoints[i],
+                )
+                continue
+            if sid != i or num != n:
+                raise ValueError(
+                    f"shard topology mismatch: endpoint "
+                    f"{self.endpoints[i]} (position {i} of {n}) "
+                    f"identifies as shard {sid} of {num} — endpoint "
+                    f"order must match the server group's shard order"
+                )
+            sig = st.get("shard_signature")
+            if sig is not None and sig != self.shard_map.signature():
+                # position and count agree but the SLICE BOUNDARIES do
+                # not — client and servers derived their maps from
+                # different weight templates (different model, dtype,
+                # or layer order); scattering would land tensors in the
+                # wrong shards' dedup tables and journals
+                raise ValueError(
+                    f"shard map signature mismatch on shard {i} "
+                    f"({self.endpoints[i]}): server built its slices "
+                    f"from a different weight template (server "
+                    f"{sig}, client {self.shard_map.signature()})"
+                )
+
+    # -- aggregated counters / views -----------------------------------
+
+    @property
+    def num_shards(self) -> int:
+        return self.shard_map.num_shards
+
+    @property
+    def bytes_sent(self) -> int:
+        return sum(p.bytes_sent for p in self._parts)
+
+    @property
+    def bytes_received(self) -> int:
+        return sum(p.bytes_received for p in self._parts)
+
+    @property
+    def updates_resent(self) -> int:
+        return sum(p.updates_resent for p in self._parts)
+
+    @property
+    def updates_duplicate(self) -> int:
+        return sum(p.updates_duplicate for p in self._parts)
+
+    @property
+    def updates_lost(self) -> int:
+        return sum(getattr(p, "updates_lost", 0) for p in self._parts)
+
+    @property
+    def pending_counts(self) -> list[int]:
+        """Parked pushes per shard (nonzero = that shard's slice is
+        paused behind an outage)."""
+        return [len(q) for q in self._pending]
+
+    @property
+    def chaos_duplicate(self):
+        return self._parts[0].chaos_duplicate
+
+    @chaos_duplicate.setter
+    def chaos_duplicate(self, hook) -> None:
+        for p in self._parts:
+            p.chaos_duplicate = hook
+
+    @property
+    def chaos_dups_sent(self) -> int:
+        return sum(p.chaos_dups_sent for p in self._parts)
+
+    def reset_counters(self) -> None:
+        for p in self._parts:
+            p.reset_counters()
+
+    def release_telemetry(self) -> None:
+        for p in self._parts:
+            p.release_telemetry()
+        telemetry.remove_series(client=self.telemetry_label)
+
+    # -- scatter/gather protocol ---------------------------------------
+
+    def get_parameters(self):
+        """Gather the full weight list. A shard that stays unreachable
+        through its client's retries serves its LAST pulled slice
+        (stale — the paused-slice degrade, counted in
+        ``elephas_ps_client_shard_stale_pulls_total``); with no slice
+        cached yet the failure propagates (serving made-up weights is
+        the one unacceptable outcome)."""
+        slices = []
+        for i, inner in enumerate(self._parts):
+            try:
+                part = inner.get_parameters()
+                self._last_slice[i] = part
+            except _WIRE_ERRORS as e:
+                part = self._last_slice[i]
+                if part is None:
+                    raise
+                self._m_stale_pulls.labels(
+                    client=self.telemetry_label, shard=str(i)
+                ).inc()
+                logger.warning(
+                    "shard %d (%s) unreachable on pull (%r) — serving "
+                    "its last-known slice; only this slice is stale",
+                    i, self.endpoints[i], e,
+                )
+            slices.append(part)
+        return self.shard_map.gather(slices)
+
+    def _drain_pending(self, i: int) -> None:
+        """Replay shard ``i``'s parked pushes in seq order (the server
+        dedups at-or-below the last applied seq, so order is
+        load-bearing)."""
+        q = self._pending[i]
+        while q:
+            seq, body = q[0]
+            self._parts[i].push_encoded(seq, body)
+            q.popleft()
+
+    def _park(self, i: int, seq: int | None, body: bytes, cause) -> None:
+        """Queue one prepared push behind shard ``i``'s outage —
+        bounded, sequenced-only (replaying an unsequenced push could
+        double-apply, so those failures propagate instead)."""
+        if seq is None:
+            raise cause
+        q = self._pending[i]
+        if len(q) >= MAX_SHARD_PENDING:
+            raise ConnectionError(
+                f"shard {i} ({self.endpoints[i]}) unreachable with "
+                f"{len(q)} pushes already parked (MAX_SHARD_PENDING="
+                f"{MAX_SHARD_PENDING}) — refusing to buffer more"
+            ) from cause
+        q.append((seq, body))
+        self._m_shard_pauses.labels(
+            client=self.telemetry_label, shard=str(i)
+        ).inc()
+
+    def update_parameters(self, delta) -> None:
+        """Scatter one delta. Live shards apply their slices now; a
+        dead shard's slice parks (encoded once, sequence ID already
+        assigned) behind its bounded pending queue — one dead shard
+        pauses only its slice. Queue overflow re-raises the shard's
+        error so the caller's supervised retry owns the backpressure."""
+        paused = []
+        for i, (inner, part) in enumerate(
+            zip(self._parts, self.shard_map.scatter(list(delta)))
+        ):
+            # the NEW slice is always prepared (encode + seq assign) so
+            # that even when the shard is down, its queue keeps strict
+            # seq order for the eventual replay — the server dedups
+            # at-or-below the last applied seq, so order is load-bearing
+            seq, body = inner.prepare_push(part)
+            try:
+                self._drain_pending(i)
+                inner.push_encoded(seq, body)
+            except _WIRE_ERRORS as e:
+                self._park(i, seq, body, e)
+                paused.append(i)
+        if paused:
+            logger.warning(
+                "update parked on paused shard(s) %s — other shards "
+                "applied their slices; flush() will confirm delivery",
+                paused,
+            )
+
+    def flush(self) -> None:
+        """Strict delivery confirmation across every shard: replay all
+        parked pushes and drain every pipelined ack. Raises (listing
+        the shards) if any shard is still unreachable — callers that
+        must not lose updates (the worker before reporting a partition
+        done) run this under their supervised retry; shards flushed on
+        an earlier attempt are cheap no-ops on the next."""
+        errors = []
+        for i, inner in enumerate(self._parts):
+            try:
+                self._drain_pending(i)
+                inner.flush()
+            except _WIRE_ERRORS as e:
+                errors.append((i, e))
+        if errors:
+            raise ConnectionError(
+                "flush incomplete on shard(s) "
+                + ", ".join(
+                    f"{i} ({self.endpoints[i]}): {e!r}" for i, e in errors
+                )
+            )
+
+    def heartbeat(self) -> None:
+        """Best-effort lease refresh on every reachable shard (liveness
+        is advisory; a dead shard's lease staying stale is exactly what
+        its membership view should show)."""
+        for i, inner in enumerate(self._parts):
+            try:
+                inner.heartbeat()
+            except _WIRE_ERRORS as e:
+                logger.debug(
+                    "heartbeat to shard %d failed (non-fatal): %r", i, e
+                )
+
+    def status(self) -> list[dict]:
+        """Per-shard status JSON, in shard order."""
+        return [p.status() for p in self._parts]
+
+    def close(self) -> None:
+        parked = sum(self.pending_counts)
+        if parked:
+            logger.warning(
+                "close() with %d parked push(es) on paused shards %s — "
+                "call flush() before close() for confirmed delivery",
+                parked,
+                [i for i, n in enumerate(self.pending_counts) if n],
+            )
+        for p in self._parts:
+            if hasattr(p, "close"):
+                p.close()

@@ -32,7 +32,9 @@ width of that loss window.
 
 from __future__ import annotations
 
+import glob
 import json
+import logging
 import os
 import struct
 
@@ -47,9 +49,38 @@ JOURNAL_NAME = "ps-journal.bin"
 
 _HEAD = struct.Struct("<4sBI")  # magic, version, meta byte length
 
+logger = logging.getLogger(__name__)
+
 
 def journal_path(directory: str) -> str:
     return os.path.join(directory, JOURNAL_NAME)
+
+
+def clean_orphaned_tmp(directory: str) -> int:
+    """Remove ``atomic_write`` temp files a crash left behind (ISSUE 6
+    satellite). ``atomic_write`` is torn-write safe — a kill between
+    the tmp write and the ``os.replace`` leaves the previous journal
+    intact — but the orphaned ``.tmp-ps-journal.bin-*`` file itself
+    stays on disk forever, and a chaos-restart loop accumulates one per
+    crash. Called on every :func:`load_journal` (i.e. every recovery);
+    returns how many orphans were removed. Unlink races (two shards'
+    recoveries sharing a directory) are tolerated."""
+    removed = 0
+    for tmp in glob.glob(
+        os.path.join(directory, ".tmp-" + JOURNAL_NAME + "-*")
+    ):
+        try:
+            os.unlink(tmp)
+            removed += 1
+        except FileNotFoundError:
+            continue  # a concurrent recovery won the unlink
+    if removed:
+        logger.warning(
+            "removed %d orphaned journal temp file(s) under %s (left "
+            "by a crash mid-snapshot; the journal itself is intact)",
+            removed, directory,
+        )
+    return removed
 
 
 def save_journal(
@@ -82,6 +113,8 @@ def load_journal(directory: str):
     expected recovery is the one unacceptable outcome.
     """
     path = journal_path(directory)
+    if os.path.isdir(directory):
+        clean_orphaned_tmp(directory)
     if not os.path.exists(path):
         return None
     with open(path, "rb") as f:

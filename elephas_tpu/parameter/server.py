@@ -116,9 +116,34 @@ class BaseParameterServer:
         journal_every: int = 50,
         lease_timeout: float = 30.0,
         restore_journal: bool = True,
+        shard_id: int | None = None,
+        num_shards: int | None = None,
+        shard_signature: str | None = None,
     ):
         self.mode = mode
         self.port = port
+        # shard identity (ISSUE 6): when this server holds one slice of
+        # a sharded topology, it says so in status() so clients can
+        # fail fast on cross-wired endpoints; None (the default) keeps
+        # the single-server shape and legacy wires untouched
+        if (shard_id is None) != (num_shards is None):
+            raise ValueError(
+                f"shard_id and num_shards come together, got shard_id="
+                f"{shard_id!r} num_shards={num_shards!r}"
+            )
+        if shard_id is not None and not 0 <= shard_id < num_shards:
+            raise ValueError(
+                f"shard_id={shard_id} out of range for num_shards="
+                f"{num_shards}"
+            )
+        if shard_signature is not None and shard_id is None:
+            raise ValueError(
+                "shard_signature needs a shard identity (shard_id/"
+                "num_shards) to ride on"
+            )
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        self.shard_signature = shard_signature
         self.lock = threading.Lock()
         self.weights = [np.asarray(w) for w in weights]
         self._started = False
@@ -170,6 +195,21 @@ class BaseParameterServer:
             "elephas_ps_heartbeats_total",
             "Worker lease refreshes received",
         )
+        if shard_id is not None:
+            # info-style gauge (value 1): joins this server instance's
+            # existing per-`server` series to its shard identity, so a
+            # scrape tells shards apart WITHOUT re-labeling the ISSUE 5
+            # counter families (the registry refuses label-schema
+            # changes on an existing name — by design)
+            reg.gauge(
+                "elephas_ps_shard_info",
+                "Shard identity of a parameter-server instance "
+                "(value 1; join on the server label)",
+                labels=("server", "shard", "num_shards"),
+            ).labels(
+                server=sid, shard=str(shard_id),
+                num_shards=str(num_shards),
+            ).set(1)
         # pull-time gauges: lag/staleness change with time, not events
         reg.gauge(
             "elephas_ps_journal_lag_updates",
@@ -345,9 +385,24 @@ class BaseParameterServer:
         whether training is healthy."""
         with self._seq_lock:
             seq_table = dict(self.seq_table)
+        shard = (
+            {}
+            if self.shard_id is None
+            # ISSUE 6: shard identity rides the existing v2 status
+            # payload — a guarded no-op on legacy wires (v1 servers
+            # have no status op at all; un-sharded v2 servers simply
+            # omit the keys, which clients treat as "cannot verify")
+            else {"shard_id": self.shard_id, "num_shards": self.num_shards}
+        )
+        if self.shard_signature is not None:
+            # slice-boundary digest (ShardMap.signature()) — lets a
+            # client catch a template mismatch (different model/dtypes)
+            # that position/count checks alone cannot see
+            shard["shard_signature"] = self.shard_signature
         return {
             "protocol_version": PROTOCOL_VERSION,
             "mode": self.mode,
+            **shard,
             "uptime_s": round(time.monotonic() - self._created_at, 3),
             "updates_applied": self.updates_applied,
             "updates_duplicate": self.updates_duplicate,

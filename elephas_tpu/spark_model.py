@@ -96,7 +96,9 @@ class SparkModel:
         port: int = 4000,
         ps_overlap: bool | None = None,
         ps_journal_dir: str | None = None,
+        ps_shards: int = 1,
         failure_budget: int = 0,
+        reassign_orphans: bool = True,
         model_parallel: int = 1,
         pipeline_parallel: int = 1,
         pipeline_microbatches: int = 4,
@@ -149,6 +151,22 @@ class SparkModel:
         # worker partitions before aborting a fit
         self.ps_journal_dir = ps_journal_dir
         self.failure_budget = max(0, int(failure_budget))
+        # sharded PS topology (ISSUE 6): ps_shards > 1 hosts the
+        # external weight store as N per-shard servers (each journaling
+        # under journal_dir/shard-<i>/) reachable via `ps_endpoints`
+        self.ps_shards = int(ps_shards)
+        if self.ps_shards < 1:
+            raise ValueError(f"ps_shards must be >= 1, got {ps_shards}")
+        if self.ps_shards > 1 and parameter_server_mode == "native":
+            raise ValueError(
+                "ps_shards > 1 needs parameter_server_mode='http' or "
+                "'socket' — the native raw-f32 wire has no shard "
+                "identity or sequence IDs"
+            )
+        # elastic membership (ISSUE 6): within failure_budget, a lost
+        # worker partition's rows are REASSIGNED to the survivors
+        # instead of dropped (False restores the ISSUE 3 drop behavior)
+        self.reassign_orphans = bool(reassign_orphans)
         self._publisher = None
         self.model_parallel = int(model_parallel)
         self.pipeline_parallel = int(pipeline_parallel)
@@ -319,7 +337,9 @@ class SparkModel:
             "port": self.port,
             "ps_overlap": self.ps_overlap,
             "ps_journal_dir": self.ps_journal_dir,
+            "ps_shards": self.ps_shards,
             "failure_budget": self.failure_budget,
+            "reassign_orphans": self.reassign_orphans,
             "model_parallel": self.model_parallel,
             "pipeline_parallel": self.pipeline_parallel,
             "pipeline_microbatches": self.pipeline_microbatches,
@@ -357,15 +377,53 @@ class SparkModel:
             # (non-resume) fit passes restore_journal=False: starting
             # over must not silently continue from a previous run's
             # journal (it gets overwritten as this run snapshots).
-            kwargs["journal_dir"] = self.ps_journal_dir
             kwargs["restore_journal"] = restore_journal
-        self._parameter_server = cls(
-            self._master_network.get_weights(), mode=self.mode,
-            port=self.port, **kwargs,
-        )
+            if self.ps_shards <= 1:
+                kwargs["journal_dir"] = self.ps_journal_dir
+        if self.ps_shards > 1:
+            # sharded topology (ISSUE 6): N per-shard servers, each
+            # holding only its slice and journaling independently
+            # under journal_dir/shard-<i>/; workers reach them through
+            # `ps_endpoints` (port=0 auto-assigns, a fixed port takes
+            # consecutive ports from there)
+            from elephas_tpu.parameter.sharding import ShardedServerGroup
+
+            ports = (
+                [0] * self.ps_shards
+                if not self.port
+                else [self.port + i for i in range(self.ps_shards)]
+            )
+            self._parameter_server = ShardedServerGroup(
+                cls,
+                self._master_network.get_weights(),
+                self.ps_shards,
+                mode=self.mode,
+                ports=ports,
+                journal_dir=self.ps_journal_dir,
+                **kwargs,
+            )
+        else:
+            self._parameter_server = cls(
+                self._master_network.get_weights(), mode=self.mode,
+                port=self.port, **kwargs,
+            )
         self._parameter_server.start()
         if self.ps_overlap and self.mode != "synchronous":
             self._publisher = _WeightPublisher(self._parameter_server)
+
+    @property
+    def ps_endpoints(self) -> str | None:
+        """The running external weight store's endpoint list — one
+        ``host:port`` (single PS) or a comma-separated shard list in
+        shard order (``ps_shards > 1``), the exact string an
+        :class:`~elephas_tpu.worker.AsynchronousSparkWorker` takes as
+        ``master=``. None until :meth:`start_server` ran."""
+        server = self._parameter_server
+        if server is None:
+            return None
+        if hasattr(server, "endpoints"):
+            return server.endpoints
+        return f"127.0.0.1:{server.port}"
 
     def stop_server(self) -> None:
         self._stop_publisher()
@@ -644,11 +702,8 @@ class SparkModel:
             # the master state, and start_server below re-seeds the PS
             # from the same journal, so neither the workers nor external
             # pollers regress past the last snapshot
-            from elephas_tpu.parameter import journal as ps_journal
-
-            state = ps_journal.load_journal(self.ps_journal_dir)
-            if state is not None:
-                journaled, _seq_table, _meta = state
+            journaled = self._load_ps_journal_weights()
+            if journaled is not None:
                 self._master_network.set_weights(journaled)
                 logger.info(
                     "resume: adopted journaled parameter-server state "
@@ -796,6 +851,51 @@ class SparkModel:
         self.training_histories.append(history)
         return history
 
+    def _load_ps_journal_weights(self):
+        """Journaled PS weights for fit(resume=True), or None. With
+        ``ps_shards > 1`` each shard journaled only its slice — gather
+        them through the SAME deterministic shard map the servers used;
+        a partially-journaled topology (some shards never snapshotted)
+        is refused as a resume source rather than mixing journal slices
+        with the (older) checkpoint weights."""
+        from elephas_tpu.parameter import journal as ps_journal
+
+        if self.ps_shards <= 1:
+            state = ps_journal.load_journal(self.ps_journal_dir)
+            return None if state is None else state[0]
+        from elephas_tpu.parameter.sharding import (
+            ShardMap,
+            shard_journal_dir,
+        )
+
+        smap = ShardMap.from_weights(
+            self._master_network.get_weights(), self.ps_shards
+        )
+        slices: list = [None] * self.ps_shards
+        missing = []
+        for i in range(self.ps_shards):
+            state = ps_journal.load_journal(
+                shard_journal_dir(self.ps_journal_dir, i)
+            )
+            if state is None:
+                missing.append(i)
+            else:
+                slices[i] = state[0]
+        if missing:
+            # warn whenever the topology is PARTIALLY journaled — which
+            # shard is missing must not decide whether the operator
+            # hears that newer journaled slices were discarded
+            if len(missing) < self.ps_shards:
+                logger.warning(
+                    "resume: shard journal(s) %s missing under %s (%d "
+                    "of %d exist) — refusing a mixed journal/checkpoint "
+                    "weight state; resuming from the checkpoint alone",
+                    missing, self.ps_journal_dir,
+                    self.ps_shards - len(missing), self.ps_shards,
+                )
+            return None
+        return smap.gather(slices)
+
     def _survive_partitions(self, partitions):
         """Worker-loss supervision (ISSUE 3): a partition whose executor
         died (``fault.check_partition`` raises under an active chaos
@@ -812,13 +912,14 @@ class SparkModel:
 
         if active_plan() is None:
             return partitions
-        survivors, lost = [], []
+        survivors, orphans, lost = [], [], []
         for i, part in enumerate(partitions):
             try:
                 check_partition(i)
             except WorkerFault as e:
                 logger.warning("worker partition %d lost: %s", i, e)
                 lost.append(i)
+                orphans.append(part)
                 continue
             survivors.append(part)
         if not lost:
@@ -831,11 +932,47 @@ class SparkModel:
                 f"raise the budget to continue degraded, or repair the "
                 f"failing workers"
             )
+        if self.reassign_orphans:
+            # elastic membership (ISSUE 6): the orphaned partitions'
+            # rows are still driver-side — re-stage them onto the
+            # survivors (round-robin, whole partitions) so the epoch
+            # trains on ALL the data with fewer workers, instead of
+            # silently shrinking the dataset by the dead workers' share
+            survivors = self._reassign_orphans(survivors, orphans)
+            logger.warning(
+                "reassigned %d orphaned partition(s) %s across %d "
+                "survivors (failure_budget=%d) — full dataset, fewer "
+                "workers", len(lost), lost, len(survivors),
+                self.failure_budget,
+            )
+            return survivors
         logger.warning(
             "continuing with %d/%d worker partitions (failure_budget=%d)",
             len(survivors), len(partitions), self.failure_budget,
         )
         return survivors
+
+    @staticmethod
+    def _reassign_orphans(survivors, orphans):
+        """Concatenate each orphaned partition onto a survivor
+        (round-robin). ``y`` may be a pytree of row-aligned arrays
+        (multi-output models) — concatenate leaf-wise."""
+        import jax
+
+        merged = list(survivors)
+        for j, (ox, oy) in enumerate(orphans):
+            t = j % len(merged)
+            sx, sy = merged[t]
+            merged[t] = (
+                np.concatenate([np.asarray(sx), np.asarray(ox)]),
+                jax.tree.map(
+                    lambda a, b: np.concatenate(
+                        [np.asarray(a), np.asarray(b)]
+                    ),
+                    sy, oy,
+                ),
+            )
+        return merged
 
     def _make_val_evaluate(self, runner, val_partitions, val_spec,
                            val_block, batch_size):
@@ -1205,7 +1342,9 @@ def load_spark_model(file_name: str) -> SparkModel:
         port=config.get("port", 4000),
         ps_overlap=config.get("ps_overlap"),
         ps_journal_dir=config.get("ps_journal_dir"),
+        ps_shards=config.get("ps_shards", 1),
         failure_budget=config.get("failure_budget", 0),
+        reassign_orphans=config.get("reassign_orphans", True),
         model_parallel=config.get("model_parallel", 1),
         pipeline_parallel=config.get("pipeline_parallel", 1),
         pipeline_microbatches=config.get("pipeline_microbatches", 4),

@@ -1094,6 +1094,16 @@ class AsynchronousSparkWorker(SparkWorker):
     re-encoded retry folds the previous attempt's residual into the
     fresh delta — DGC's delayed-error contract, preserved across
     failures.
+
+    ISSUE 6 (sharded PS): ``master="host:p0,host:p1,..."`` — a
+    comma-separated endpoint list — routes the same pull/train/push
+    loop through a :class:`~elephas_tpu.parameter.client.ShardedClient`
+    (scatter/gather over per-shard servers, per-shard sequence IDs,
+    one dead shard pausing only its slice). Workers may join and leave
+    such a topology mid-run: registration is implicit (first heartbeat
+    or sequenced update) and a departed worker's lease simply goes
+    stale, so elastic data-parallel membership needs no coordinator
+    round-trip.
     """
 
     def __init__(
@@ -1170,6 +1180,38 @@ class AsynchronousSparkWorker(SparkWorker):
     def _client(self, model=None):
         from elephas_tpu.parameter.client import HttpClient, SocketClient
 
+        if self.master and "," in str(self.master):
+            # sharded topology (ISSUE 6): a comma-separated endpoint
+            # list selects the scatter/gather client — the worker
+            # derives the SAME deterministic shard map the server group
+            # derived from the same weight template
+            from elephas_tpu.parameter.client import ShardedClient
+            from elephas_tpu.parameter.sharding import (
+                ShardMap,
+                shard_endpoints,
+            )
+
+            if self.parameter_server_mode not in ("http", "socket"):
+                raise ValueError(
+                    f"sharded endpoint lists need parameter_server_mode="
+                    f"'http' or 'socket', got "
+                    f"{self.parameter_server_mode!r}"
+                )
+            if model is None:
+                raise ValueError(
+                    "sharded endpoints need the built model to derive "
+                    "the shard map from its weight template"
+                )
+            endpoints = shard_endpoints(self.master)
+            return ShardedClient(
+                endpoints,
+                ShardMap.from_weights(model.get_weights(), len(endpoints)),
+                transport=self.parameter_server_mode,
+                client_id=self.client_id,
+                compression=self.compression, topk=self.topk,
+                pull_compression=self.pull_compression,
+                retries=max(3, self.ps_retries) if self.overlap else 3,
+            )
         if self.parameter_server_mode == "native":
             if (
                 self.compression != "none"

@@ -46,6 +46,7 @@ _PS_DEADLINE_MODULES = (
     "test_ps_codec",
     "test_ps_overlap",
     "test_fault_tolerance",
+    "test_ps_sharding",
     "test_telemetry",
 )
 PS_TEST_DEADLINE_S = 120

@@ -79,6 +79,35 @@ def test_journal_missing_returns_none_corrupt_raises():
             journal.load_journal(d)
 
 
+def test_crash_mid_snapshot_recovers_and_cleans_tmp():
+    """Torn-write recovery (ISSUE 6 satellite): a kill BETWEEN the tmp
+    write and the atomic replace leaves the previous journal intact
+    plus an orphaned ``.tmp-ps-journal.bin-*`` file (atomic_write names
+    tmps exactly so); the next load_journal recovers the OLD state and
+    removes the orphan (a chaos-restart loop must not accumulate one
+    tmp file per crash). Foreign tmp files are left alone."""
+    import glob as _glob
+
+    with tempfile.TemporaryDirectory() as d:
+        good = [np.full(4, 2.0, np.float32)]
+        journal.save_journal(d, good, {"w": 5})
+        # the exact post-SIGKILL disk state: a half-written snapshot
+        # under atomic_write's tmp naming, never replaced into place
+        torn = os.path.join(d, ".tmp-" + journal.JOURNAL_NAME + "-x1y2")
+        with open(torn, "wb") as f:
+            f.write(b"EPSJ\x01torn mid-write")
+        foreign = os.path.join(d, ".tmp-something-else")
+        with open(foreign, "wb") as f:
+            f.write(b"not ours")
+
+        restored, seq, _ = journal.load_journal(d)
+        np.testing.assert_array_equal(restored[0], good[0])  # old state
+        assert seq == {"w": 5}
+        assert not os.path.exists(torn)  # orphan cleaned
+        assert os.path.exists(foreign)  # not ours: untouched
+        assert os.path.exists(journal.journal_path(d))
+
+
 # -- idempotent apply (the acceptance bit-exact clause) ------------------
 
 

@@ -1,0 +1,482 @@
+"""Sharded parameter-server topology (ISSUE 6): deterministic shard
+maps, scatter/gather bit-exactness against a single PS, per-shard
+journals and recovery, partial-failure isolation (one dead shard pauses
+only its slice), loud topology validation, and elastic worker
+membership.
+
+These tests ride the same per-test SIGALRM deadline as the other PS
+socket suites (conftest ``_PS_DEADLINE_MODULES``).
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from elephas_tpu.fault import (
+    FaultPlan,
+    ShardedRestartablePS,
+    run_elastic_membership,
+    run_sharded_chaos_training,
+    use_plan,
+)
+from elephas_tpu.parameter.client import (
+    HttpClient,
+    ShardedClient,
+    SocketClient,
+)
+from elephas_tpu.parameter.server import HttpServer, SocketServer
+from elephas_tpu.parameter.sharding import (
+    ShardMap,
+    ShardedServerGroup,
+    shard_endpoints,
+    shard_journal_dir,
+)
+
+_SERVERS = {"socket": SocketServer, "http": HttpServer}
+_CLIENTS = {"socket": SocketClient, "http": HttpClient}
+
+
+def _weights(seed: int = 0, n: int = 5):
+    rng = np.random.default_rng(seed)
+    shapes = [(8, 4), (4,), (3, 3), (6,), (2, 2, 2)][:n]
+    return [rng.normal(size=s).astype(np.float32) for s in shapes]
+
+
+def _deltas(seed: int, rounds: int, template):
+    rng = np.random.default_rng(seed)
+    return [
+        [rng.normal(size=np.shape(w)).astype(np.float32) for w in template]
+        for _ in range(rounds)
+    ]
+
+
+# -- shard map -----------------------------------------------------------
+
+
+def test_shard_map_deterministic_and_balanced():
+    w = _weights()
+    a, b = ShardMap.from_weights(w, 2), ShardMap.from_weights(w, 2)
+    assert a.signature() == b.signature()
+    assert [a.shard_of(i) for i in range(len(w))] == [
+        b.shard_of(i) for i in range(len(w))
+    ]
+    # every shard owns at least one tensor; scatter/gather round-trips
+    assert all(a.indices_of(s) for s in range(2))
+    back = a.gather(a.scatter(w))
+    for x, y in zip(back, w):
+        np.testing.assert_array_equal(x, y)
+    # a different shard count is a different topology
+    assert a.signature() != ShardMap.from_weights(w, 3).signature()
+
+
+def test_shard_map_validation_is_loud():
+    w = _weights(n=3)
+    with pytest.raises(ValueError, match="empty weight list"):
+        ShardMap([], 2)
+    with pytest.raises(ValueError, match="num_shards"):
+        ShardMap.from_weights(w, 0)
+    with pytest.raises(ValueError, match="empty shard"):
+        ShardMap.from_weights(w, 4)  # more shards than tensors
+    m = ShardMap.from_weights(w, 2)
+    with pytest.raises(ValueError, match="covers 3 tensors"):
+        m.scatter(w[:2])
+    with pytest.raises(ValueError, match="topology mismatch"):
+        m.gather([m.scatter(w)[0], []])  # short slice
+
+
+def test_endpoint_list_validation_is_loud():
+    with pytest.raises(ValueError, match="empty entry"):
+        shard_endpoints("host:1,,host:2")
+    with pytest.raises(ValueError, match="duplicate endpoint"):
+        shard_endpoints("host:1,host:1")
+    assert shard_endpoints("a:1, b:2") == ["a:1", "b:2"]
+
+
+def test_sharded_client_refuses_endpoint_count_mismatch():
+    with pytest.raises(ValueError, match="cross-wire"):
+        ShardedClient(
+            "h:1,h:2,h:3", ShardMap.from_weights(_weights(), 2),
+        )
+
+
+def test_cross_wired_endpoints_fail_fast():
+    """Two shard servers listed in the WRONG order must be refused at
+    construction (shard identity vs endpoint position), not silently
+    scatter slices into the wrong dedup tables."""
+    w = _weights()
+    grp = ShardedServerGroup(SocketServer, w, 2)
+    grp.start()
+    try:
+        ports = grp.ports
+        swapped = f"127.0.0.1:{ports[1]},127.0.0.1:{ports[0]}"
+        with pytest.raises(ValueError, match="topology mismatch"):
+            ShardedClient(
+                swapped, ShardMap.from_weights(w, 2), transport="socket"
+            )
+    finally:
+        grp.stop()
+
+
+def test_http_prepare_push_unsequenced_on_known_legacy_server():
+    """A known-legacy HTTP server ignores the sequence headers, so
+    prepare_push must not hand out a seq — a seq is a promise of
+    dedup-protected replay, and the sharded client parks/replays only
+    sequenced pushes (replaying an unsequenced one could double-apply).
+    """
+    client = HttpClient(master="127.0.0.1:1")
+    seq, _ = client.prepare_push([np.ones(4, np.float32)])
+    assert seq is not None  # unknown server: sequenced by default
+    client._binary = False  # negotiated legacy
+    seq2, _ = client.prepare_push([np.ones(4, np.float32)])
+    assert seq2 is None
+    client.close()
+
+
+def test_signature_mismatch_fails_fast():
+    """Position and count can agree while the SLICE BOUNDARIES do not
+    (client map built from a different weight template) — the
+    shard_signature stamped into status must catch it at construction,
+    before any scatter lands tensors in the wrong shards."""
+    w = _weights()
+    grp = ShardedServerGroup(SocketServer, w, 2)
+    grp.start()
+    try:
+        other = [x.astype(np.float64) for x in _weights(seed=1)]
+        bad_map = ShardMap.from_weights(other, 2)
+        assert bad_map.signature() != grp.shard_map.signature()
+        with pytest.raises(ValueError, match="signature mismatch"):
+            ShardedClient(grp.endpoints, bad_map, transport="socket")
+        # the matching map still validates clean
+        ShardedClient(
+            grp.endpoints, ShardMap.from_weights(w, 2),
+            transport="socket",
+        ).close()
+    finally:
+        grp.stop()
+
+
+def test_status_carries_shard_identity_and_plain_servers_omit_it():
+    w = _weights()
+    sharded = SocketServer(w, port=0, shard_id=1, num_shards=3)
+    plain = SocketServer(w, port=0)
+    assert sharded.status()["shard_id"] == 1
+    assert sharded.status()["num_shards"] == 3
+    assert "shard_id" not in plain.status()  # guarded no-op, legacy shape
+    with pytest.raises(ValueError, match="come together"):
+        SocketServer(w, port=0, shard_id=0)
+    with pytest.raises(ValueError, match="out of range"):
+        SocketServer(w, port=0, shard_id=3, num_shards=3)
+
+
+# -- scatter/gather bit-exactness vs a single PS -------------------------
+
+
+@pytest.mark.parametrize("transport", ["socket", "http"])
+def test_sharded_bit_exact_vs_single_ps(transport):
+    """The same delta sequence at compression='none' lands bit-exactly
+    identical final weights through a 2-shard topology and through one
+    single server — sharding changes WHERE tensors live, never their
+    values."""
+    w = _weights(seed=3)
+    deltas = _deltas(seed=4, rounds=6, template=w)
+    server_cls, client_cls = _SERVERS[transport], _CLIENTS[transport]
+
+    single = server_cls([x.copy() for x in w], port=0)
+    single.start()
+    try:
+        client = client_cls(master=f"127.0.0.1:{single.port}",
+                            client_id="w0")
+        for d in deltas:
+            client.update_parameters(d)
+        getattr(client, "flush", lambda: None)()
+        expected = client.get_parameters()
+        if hasattr(client, "close"):
+            client.close()
+    finally:
+        single.stop()
+
+    grp = ShardedServerGroup(server_cls, [x.copy() for x in w], 2)
+    grp.start()
+    try:
+        sharded = ShardedClient(
+            grp.endpoints, ShardMap.from_weights(w, 2),
+            transport=transport, client_id="w0",
+        )
+        for d in deltas:
+            sharded.update_parameters(d)
+        sharded.flush()
+        got = sharded.get_parameters()
+        sharded.close()
+    finally:
+        grp.stop()
+    assert grp.updates_applied == len(deltas) * 2  # each shard, each round
+    for a, b in zip(got, expected):
+        np.testing.assert_array_equal(a, b)  # bit-exact
+
+
+def test_sharded_duplicates_and_kill_bit_exact():
+    """The acceptance clause at the protocol level: a seeded duplicate
+    schedule plus a crash-kill/journal-restart of ONE shard still lands
+    final weights bit-exactly equal to a duplicate-free, fault-free
+    run — per-shard sequence dedup survives the restart."""
+    w = _weights(seed=5)
+    deltas = _deltas(seed=6, rounds=8, template=w)
+    plan = FaultPlan(seed=1, duplicate_fraction=0.25)
+
+    grp = ShardedServerGroup(SocketServer, [x.copy() for x in w], 2)
+    grp.start()
+    try:
+        clean = ShardedClient(
+            grp.endpoints, ShardMap.from_weights(w, 2),
+            transport="socket", client_id="w0",
+        )
+        for d in deltas:
+            clean.update_parameters(d)
+        clean.flush()
+        expected = clean.get_parameters()
+        clean.close()
+    finally:
+        grp.stop()
+
+    with tempfile.TemporaryDirectory() as jd:
+        ps = ShardedRestartablePS(
+            SocketServer, [x.copy() for x in w], 2,
+            journal_dir=jd, journal_every=1,
+        )
+        try:
+            chaotic = ShardedClient(
+                ps.endpoints, ShardMap.from_weights(w, 2),
+                transport="socket", client_id="w0", retries=1,
+            )
+            chaotic.chaos_duplicate = plan.duplicate
+            for i, d in enumerate(deltas):
+                if i == len(deltas) // 2:
+                    ps.kill(0)
+                    ps.restart(0)
+                    assert ps.servers[0].restored_from_journal
+                chaotic.update_parameters(d)
+            chaotic.flush()
+            assert chaotic.chaos_dups_sent >= len(deltas) // 5
+            got = chaotic.get_parameters()
+            counters = ps.counters()
+            chaotic.close()
+        finally:
+            ps.stop()
+    # every duplicate (and every post-restart replay) was a no-op
+    assert counters["updates_applied"] == len(deltas) * 2
+    for a, b in zip(got, expected):
+        np.testing.assert_array_equal(a, b)  # bit-exact
+
+
+# -- partial-failure isolation -------------------------------------------
+
+
+def test_one_dead_shard_pauses_only_its_slice():
+    """Kill shard 0: its pushes park (bounded), its pulls serve the
+    last-known slice — while shard 1 keeps applying every round. After
+    a journal restart, flush() replays the parked pushes exactly-once."""
+    w = [np.zeros((3, 4), np.float32), np.zeros(4, np.float32),
+         np.zeros((2, 2), np.float32)]
+    m = ShardMap.from_weights(w, 2)
+    delta = [np.ones_like(x) for x in w]
+    with tempfile.TemporaryDirectory() as jd:
+        ps = ShardedRestartablePS(
+            SocketServer, w, 2, journal_dir=jd, journal_every=1,
+        )
+        try:
+            cl = ShardedClient(
+                ps.endpoints, m, transport="socket", client_id="w0",
+                retries=1,
+            )
+            cl.update_parameters(delta)
+            cl.flush()
+            cl.get_parameters()  # seed the stale-slice cache
+            ps.kill(0)
+            before = ps.shard_counters(1)["updates_applied"]
+            for _ in range(3):
+                cl.update_parameters(delta)  # shard 0 parks, shard 1 applies
+            # socket pushes are pipelined — confirm the live shard's
+            # deliveries before reading its counter (shard 0 stays dead)
+            cl._parts[1].flush()
+            assert ps.shard_counters(1)["updates_applied"] == before + 3
+            assert cl.pending_counts[0] >= 2  # paused slice, bounded queue
+            assert cl.pending_counts[1] == 0
+            stale = cl.get_parameters()  # full list despite the dead shard
+            # shard 0's slice is frozen at its last pulled value (1.0);
+            # shard 1's slice is live (4.0)
+            by_shard = m.scatter(stale)
+            assert float(np.max(by_shard[0][0])) == 1.0
+            assert float(np.max(by_shard[1][0])) == 4.0
+            ps.restart(0)
+            assert ps.servers[0].restored_from_journal
+            cl.flush()
+            assert cl.pending_counts == [0, 0]
+            got = cl.get_parameters()
+            for a, b in zip(got, [4.0 * np.ones_like(x) for x in w]):
+                np.testing.assert_array_equal(a, b)  # exactly-once
+            assert cl.updates_lost == 0
+            cl.close()
+        finally:
+            ps.stop()
+
+
+def test_dead_shard_pull_without_cache_raises():
+    """With no slice cached yet, a dead shard's pull must FAIL, not
+    invent weights."""
+    w = _weights(n=3)
+    ps = ShardedRestartablePS(SocketServer, w, 2)
+    try:
+        cl = ShardedClient(
+            ps.endpoints, ShardMap.from_weights(w, 2),
+            transport="socket", client_id="w0", retries=0,
+        )
+        ps.kill(1)
+        with pytest.raises((ConnectionError, OSError)):
+            cl.get_parameters()
+        cl.close()
+    finally:
+        ps.stop()
+
+
+# -- per-shard journals --------------------------------------------------
+
+
+def test_per_shard_journal_replay_after_kill():
+    """Each shard journals only its slice under journal_dir/shard-<i>/
+    and a killed shard restarts from ITS journal alone — the other
+    shard's journal is untouched."""
+    w = _weights(seed=7, n=4)
+    m = ShardMap.from_weights(w, 2)
+    delta = [np.full_like(x, 0.5) for x in w]
+    with tempfile.TemporaryDirectory() as jd:
+        ps = ShardedRestartablePS(
+            SocketServer, w, 2, journal_dir=jd, journal_every=1,
+        )
+        try:
+            cl = ShardedClient(
+                ps.endpoints, m, transport="socket", client_id="w0",
+            )
+            for _ in range(2):
+                cl.update_parameters(delta)
+            cl.flush()
+            # both shard journal dirs exist and hold only their slices
+            from elephas_tpu.parameter import journal as journal_io
+
+            for i in range(2):
+                state = journal_io.load_journal(shard_journal_dir(jd, i))
+                assert state is not None
+                weights_i, seq_i, _ = state
+                assert len(weights_i) == len(m.indices_of(i))
+                assert seq_i == {"w0": 1}
+            ps.kill(0)
+            ps.restart(0)
+            assert ps.servers[0].restored_from_journal
+            got = cl.get_parameters()
+            for a, b in zip(got, w):
+                np.testing.assert_allclose(
+                    a, np.asarray(b) + 1.0, rtol=1e-6
+                )
+            cl.close()
+        finally:
+            ps.stop()
+
+
+# -- elastic membership --------------------------------------------------
+
+
+@pytest.mark.slow  # three real keras workers in threads
+def test_elastic_workers_join_and_leave_mid_run():
+    """A worker that LEAVES mid-run (trains a head slice, flushes,
+    closes) and one that JOINS mid-run both register implicitly; every
+    push applies exactly-once and the final model beats the initial
+    loss (converges despite churn)."""
+    from elephas_tpu.fault.harness import _chaos_data, _chaos_model
+
+    out = run_elastic_membership(
+        "socket", num_shards=2, rows=96, batch_size=32, seed=0,
+    )
+    for members in out["members_by_shard"]:
+        assert {"steady", "leaver", "joiner"} <= set(members)
+    assert out["updates_duplicate"] == 0
+    # 2 shards × (3 + 1 + 2) batch periods across the three workers
+    assert out["updates_applied"] == 2 * 6
+    x, y, d, k = _chaos_data(0, 96)
+    model = _chaos_model(0, d, k)
+    initial = float(model.evaluate(x, y, verbose=0))
+    model.set_weights(out["final_weights"])
+    assert float(model.evaluate(x, y, verbose=0)) < initial
+
+
+def test_orphaned_partitions_reassigned_under_budget(blobs):
+    """ISSUE 6 elastic driver: a lost partition's rows move to the
+    survivors (full dataset, fewer workers) instead of being dropped —
+    and the budget still gates how many losses are tolerated."""
+    import keras
+
+    from elephas_tpu import SparkModel
+    from elephas_tpu.fault import FaultBudgetExceeded
+
+    x, y, d, k = blobs
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([
+        keras.layers.Input((d,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(k, activation="softmax"),
+    ])
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    sm = SparkModel(
+        model, mode="asynchronous", num_workers=4, failure_budget=1,
+    )
+    parts = [
+        (x[i * 64:(i + 1) * 64], y[i * 64:(i + 1) * 64]) for i in range(4)
+    ]
+    merged = sm._reassign_orphans(parts[:3], parts[3:])
+    assert sum(len(px) for px, _ in merged) == 4 * 64  # no rows lost
+    with use_plan(FaultPlan(seed=0, failed_partitions=(2,))):
+        history = sm.fit((x[:256], y[:256]), epochs=1, batch_size=32)
+    assert len(history["loss"]) == 1
+    with use_plan(FaultPlan(seed=0, failed_partitions=(0, 2))):
+        with pytest.raises(FaultBudgetExceeded):
+            sm.fit((x[:256], y[:256]), epochs=1, batch_size=32)
+
+
+# -- multi-shard chaos, end to end (slow) --------------------------------
+
+
+@pytest.mark.slow  # two full keras training runs + kill/restart
+def test_sharded_chaos_partial_progress_and_recovery(tmp_path):
+    """The acceptance scenario: killing one shard mid-run pauses only
+    that shard's slice (the other shard's updates_applied keeps
+    rising), the restarted shard recovers from its own journal with
+    zero double-applies, and the per-shard recovery window from the
+    shard-stamped trace span agrees with the counters-side pair."""
+    clean = run_sharded_chaos_training(
+        "socket", num_shards=2, rows=192, epochs=2, batch_size=64,
+        seed=0, plan=None,
+    )
+    plan = FaultPlan(
+        seed=0, kill_ps_after_updates=2, restart_delay_s=0.4,
+        duplicate_fraction=0.25, kill_shard=0,
+    )
+    faulted = run_sharded_chaos_training(
+        "socket", num_shards=2, rows=192, epochs=2, batch_size=64,
+        seed=0, plan=plan, journal_dir=str(tmp_path),
+    )
+    assert faulted["kills"] == [1, 0] and faulted["restarts"] == [1, 0]
+    # the surviving shard kept applying inside the outage window
+    assert faulted["other_shards_progress_during_outage"][1] >= 1
+    # per-shard recovery from the shard-stamped trace span, agreeing
+    # with the counters-side timestamp pair
+    trace_w = faulted["recovery_s_by_shard"]
+    counters_w = faulted["recovery_s_counters_by_shard"]
+    assert trace_w[0] is not None and trace_w[1] is None
+    assert abs(trace_w[0] - counters_w[0]) < 0.5
+    # exactly-once per shard despite duplicates + parked replays
+    assert (
+        faulted["updates_applied_by_shard"]
+        == clean["updates_applied_by_shard"]
+    )
+    assert faulted["duplicates_sent"] >= 1
+    assert faulted["updates_lost_final"] == 0
+    assert not any(faulted["pending_final"])
