@@ -21,16 +21,33 @@ scheduling, vLLM-style slot/paged KV):
 - :mod:`elephas_tpu.serving.engine` — :class:`InferenceEngine`, the
   host-side driver (surfaced as ``SparkModel.serve()``): submit
   requests at any time, stream tokens back per request, run the same
-  fixed-shape jitted step for the life of the server.
+  fixed-shape jitted step for the life of the server;
+- :mod:`elephas_tpu.serving.paged_kv` + :mod:`elephas_tpu.serving.\
+blocks` — the paged arena (ISSUE 7, ``serve(paged=True)``): a global
+  block pool with per-slot block tables, so each request reserves only
+  its OWN worst case, prompt-prefix blocks share copy-free by refcount
+  (:class:`~elephas_tpu.serving.prefix_cache.PagedPrefixIndex`), and
+  low-priority requests can be preempted — K/V swapped to host — and
+  later resumed bit-exact.
 """
 
+from elephas_tpu.serving.blocks import BlockAllocator  # noqa: F401
 from elephas_tpu.serving.engine import InferenceEngine  # noqa: F401
-from elephas_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
+from elephas_tpu.serving.prefix_cache import (  # noqa: F401
+    PagedPrefixIndex,
+    PrefixCache,
+)
 from elephas_tpu.serving.scheduler import (  # noqa: F401
     Admission,
+    Preemption,
     Request,
     Scheduler,
     bucket_for,
     default_buckets,
 )
 from elephas_tpu.serving.kv_cache import SlotKVCache  # noqa: F401
+from elephas_tpu.serving.paged_kv import (  # noqa: F401
+    PagedKVPool,
+    blocks_for,
+    table_buckets,
+)

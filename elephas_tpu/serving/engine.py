@@ -40,12 +40,23 @@ import time
 import numpy as np
 
 from elephas_tpu import telemetry
+from elephas_tpu.serving.blocks import BlockAllocator
 from elephas_tpu.serving.kv_cache import (
     SlotKVCache,
     chunked_prefill_forward,
     prefill_forward,
     prefix_copy,
     token_decode_step,
+)
+from elephas_tpu.serving.paged_kv import (
+    PagedKVPool,
+    blocks_for,
+    gather_blocks,
+    paged_chunk_forward,
+    paged_token_decode_step,
+    scatter_blocks,
+    table_bucket_for,
+    table_buckets,
 )
 from elephas_tpu.serving.scheduler import (
     Admission,
@@ -55,6 +66,24 @@ from elephas_tpu.serving.scheduler import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+class _OffloadRecord:
+    """Host-side K/V of a preempted request: dense block rows per
+    layer (``{name: (k, v)}``, each ``[n_blocks, block_size, H, Dh]``
+    numpy) plus the cursor state needed for a bit-exact resume."""
+
+    __slots__ = ("rows", "n_blocks", "cur_len")
+
+    def __init__(self, rows, n_blocks, cur_len):
+        self.rows = rows
+        self.n_blocks = int(n_blocks)
+        self.cur_len = int(cur_len)
+
+    def nbytes(self) -> int:
+        return sum(
+            k.nbytes + v.nbytes for k, v in self.rows.values()
+        )
 
 
 def _sample_dynamic(logits, key, temps, top_k, top_p):
@@ -98,6 +127,17 @@ class InferenceEngine:
     differ from the unchunked engine (still deterministic per
     configuration); temperature-0 tokens are exact either way.
 
+    ``paged=True`` (ISSUE 7) swaps the fixed arena for the paged
+    block pool (``block_size=``, ``num_blocks=``): per-request block
+    reservations instead of per-slot maxlen rows, copy-free prefix
+    sharing by refcount when ``prefix_cache=True``, and — with
+    ``preemption=True`` — priority-ordered preempt → host-offload →
+    resume under pool pressure (bit-exact on resume). A request that
+    can never fit the pool is rejected gracefully at ``submit()``
+    (``req.error``) instead of wedging the queue. Compiled shapes
+    stay a closed set: one decode program per block-table bucket,
+    one chunk program per (width, table bucket).
+
     PP ring decode is not integrated yet — construct via
     ``SparkModel.serve()`` on a DP/TP mesh, or directly on no mesh.
     """
@@ -109,7 +149,11 @@ class InferenceEngine:
                  prefix_cache: bool = False,
                  prefix_min_reuse: int = 1,
                  prefill_chunk: int | None = None,
-                 prefill_budget: int | None = None):
+                 prefill_budget: int | None = None,
+                 paged: bool = False,
+                 block_size: int | None = None,
+                 num_blocks: int | None = None,
+                 preemption: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -196,14 +240,81 @@ class InferenceEngine:
             else (prefill_chunk or 0)
         )
 
-        self.arena = SlotKVCache(
-            flash_layers, self.num_slots, self.maxlen,
-            mesh=mesh, batch_axes=self.batch_axes, model_axis=model_axis,
-        )
+        # -- paged arena knobs (ISSUE 7) -------------------------------
+        self.paged = bool(paged)
+        if not self.paged:
+            if block_size is not None or num_blocks is not None:
+                raise ValueError(
+                    "block_size/num_blocks require paged=True — the "
+                    "fixed arena has no blocks, silently ignoring the "
+                    "knobs would misreport capacity"
+                )
+            if preemption:
+                raise ValueError(
+                    "preemption requires paged=True — the fixed arena "
+                    "has no block pool to swap out of"
+                )
+            self.block_size = None
+            self.num_blocks = None
+        else:
+            bs = 16 if block_size is None else int(block_size)
+            if not 0 < bs <= self.maxlen:
+                raise ValueError(
+                    f"block_size={bs} outside (0, maxlen={self.maxlen}]"
+                )
+            self.block_size = bs
+            # blocks any single request may need (full maxlen context)
+            self.max_blocks_per_slot = blocks_for(self.maxlen, bs)
+            # default pool: capacity parity with the fixed arena —
+            # every slot could still hold a full-maxlen context; the
+            # paged win is that short requests stop RESERVING that
+            nb = (
+                int(num_blocks) if num_blocks is not None
+                else self.num_slots * self.max_blocks_per_slot
+            )
+            if nb < 1:
+                raise ValueError(f"num_blocks={nb} < 1")
+            self.num_blocks = nb
+            self._tbuckets = table_buckets(self.max_blocks_per_slot)
+        self.preemption = bool(preemption)
+
+        if self.paged:
+            self.arena = PagedKVPool(
+                flash_layers, self.num_blocks, self.block_size,
+                mesh=mesh, batch_axes=self.batch_axes,
+                model_axis=model_axis,
+            )
+        else:
+            self.arena = SlotKVCache(
+                flash_layers, self.num_slots, self.maxlen,
+                mesh=mesh, batch_axes=self.batch_axes,
+                model_axis=model_axis,
+            )
+        # -- telemetry identity captured EARLY so the allocator's gauge
+        # shares the engine's label set (release_telemetry retires them
+        # together); the metric definitions follow below
+        treg = telemetry.registry()
+        self._telemetry_registry = treg
+        self._tracer = telemetry.tracer()
+        eid = telemetry.instance_label()
+        self.telemetry_label = eid
+
+        allocator = None
+        if self.paged:
+            allocator = BlockAllocator(
+                self.num_blocks, self.block_size,
+                free_gauge=treg.gauge(
+                    "elephas_serving_blocks_free",
+                    "Unleased KV pool blocks (paged arena)",
+                    labels=("engine",),
+                ).labels(engine=eid),
+            )
         self.scheduler = Scheduler(
             self.num_slots, buckets or default_buckets(self.maxlen),
             prefix_cache=prefix_cache,
             prefix_min_reuse=prefix_min_reuse,
+            allocator=allocator,
+            preemption=preemption,
         )
         self._rules = rules
         self._seed = int(seed)
@@ -225,17 +336,11 @@ class InferenceEngine:
         # registry counter (which reads 0 under telemetry null mode)
         self._evictions_seen = 0
 
-        # -- telemetry (ISSUE 5): the registry/tracer captured HERE are
-        # the engine's for life, so an engine built under null mode
+        # -- telemetry (ISSUE 5): the registry/tracer captured above
+        # are the engine's for life, so an engine built under null mode
         # stays ~zero-overhead even if the global flag flips later.
         # Counters are report-only views (`total_generated` etc. read
         # them back); nothing below drives control flow.
-        treg = telemetry.registry()
-        self._telemetry_registry = treg
-        self._tracer = telemetry.tracer()
-        eid = telemetry.instance_label()
-        self.telemetry_label = eid
-
         def _c(name, help_):
             return treg.counter(
                 name, help_, labels=("engine",)
@@ -273,6 +378,27 @@ class InferenceEngine:
             "Arrival gap between consecutive tokens of one request",
             labels=("engine",),
         ).labels(engine=eid)
+        # paged-arena accounting (ISSUE 7): counters exist in BOTH
+        # modes so stats() keys never vary by config — the fixed arena
+        # simply never increments them
+        self._m_preemptions = _c(
+            "elephas_serving_preemptions_total",
+            "Requests preempted (blocks offloaded to host) so a "
+            "higher-priority arrival could admit",
+        )
+        self._m_resumes = _c(
+            "elephas_serving_resumes_total",
+            "Preempted requests restored from host offload",
+        )
+        self._m_offload_blocks = _c(
+            "elephas_serving_offloaded_blocks_total",
+            "KV pool blocks swapped to host memory by preemption",
+        )
+        self._m_rejected = _c(
+            "elephas_serving_rejected_total",
+            "Requests rejected at submit because prompt + "
+            "max_new_tokens can never fit the block pool",
+        )
         treg.gauge(
             "elephas_serving_slots", "KV-cache slots in the arena",
             labels=("engine",),
@@ -282,6 +408,12 @@ class InferenceEngine:
             "Host-side size estimate of the full (f32) KV arena",
             labels=("engine",),
         ).labels(engine=eid).set(self.arena.nbytes())
+        if self.paged:
+            treg.gauge(
+                "elephas_serving_blocks_total",
+                "KV pool blocks in the paged arena",
+                labels=("engine",),
+            ).labels(engine=eid).set(self.num_blocks)
 
         maxlen, arena = self.maxlen, self.arena
 
@@ -432,24 +564,123 @@ class InferenceEngine:
                 prefix_copy(caches, src_idx, copy_mask, copy_len, maxlen)
             )
 
+        # -- paged programs (ISSUE 7): same sampling/advance math as
+        # the fixed-arena decode/chunk bodies, with storage indirected
+        # through the block tables. Compiled once per table-length
+        # bucket (decode) / (chunk width, table bucket) pair — tables
+        # ride as a traced [num_slots, T] argument, so only the bucket
+        # SHAPE triggers a compile.
+        def paged_decode(w, caches, tables, lengths, last, temps,
+                         active, key):
+            def body(i, carry):
+                caches, lengths, last, key, toks = carry
+                positions = jnp.minimum(lengths, maxlen - 1)
+                logits, caches = paged_token_decode_step(
+                    model, w, last, positions, caches, tables,
+                    self.block_size, maxlen, active,
+                    local=mesh is None,
+                )
+                caches = _constrain_all(caches)
+                key, sub = jax.random.split(key)
+                sampled = _sample_dynamic(
+                    logits, sub, temps, self.top_k, self.top_p
+                )
+                lengths = _vec(jnp.where(
+                    active, jnp.minimum(lengths + 1, maxlen), lengths
+                ))
+                toks = toks.at[i].set(sampled)
+                last = _vec(jnp.where(active, sampled, last))
+                return caches, lengths, last, key, toks
+
+            toks0 = jnp.zeros((k_window, self.num_slots), jnp.int32)
+            caches, lengths, last, key, toks = jax.lax.fori_loop(
+                0, k_window, body, (caches, lengths, last, key, toks0)
+            )
+            return caches, lengths, last, key, toks
+
+        def paged_chunk_step(w, caches, tables, tokens, offs, clens,
+                             act, fin, lengths, last, temps, p_lens,
+                             new_temps, key):
+            """The ONLY paged prefill program: cold prompts are chunks
+            from offset 0, prefix hits start at their shared-block
+            boundary — no whole-bucket prefill, no copy program (the
+            splice already happened in the host block table)."""
+            logits, caches = paged_chunk_forward(
+                model, w, tokens, caches, tables, offs, clens, act,
+                self.block_size, maxlen, local=mesh is None,
+            )
+            caches = _constrain_all(caches)
+            C = tokens.shape[1]
+            at_end = (
+                (p_lens - offs - 1)[:, None] == jnp.arange(C)[None, :]
+            ).astype(logits.dtype)
+            last_logits = jnp.einsum("bc,bcv->bv", at_end, logits)
+            key, sub = jax.random.split(key)
+            firsts = _sample_dynamic(
+                last_logits, sub, new_temps, self.top_k, self.top_p
+            )
+            lengths = _vec(jnp.where(fin, p_lens, lengths))
+            last = _vec(jnp.where(fin, firsts, last))
+            temps = _vec(jnp.where(fin, new_temps, temps))
+            return caches, lengths, last, temps, key, firsts
+
+        def offload_rows(caches, ids):
+            # read-only: the pool is NOT donated — it survives for the
+            # same step's admissions to write into
+            return gather_blocks(caches, ids)
+
+        def restore_rows(caches, ids, rows):
+            return _constrain_all(scatter_blocks(caches, ids, rows))
+
+        def resume_state(lengths, last, temps, mask, r_len, r_last,
+                         r_temps):
+            return (
+                _vec(jnp.where(mask, r_len, lengths)),
+                _vec(jnp.where(mask, r_last, last)),
+                _vec(jnp.where(mask, r_temps, temps)),
+            )
+
         # the fixed program set: ONE decode window + one prefill per
         # prompt bucket (p_lens/admit/new_temps ride as traced vectors,
         # so only the bucket SHAPE triggers a compile), plus ONE prefix
         # copy shape and one chunk program per chunk width (a single
-        # width under `prefill_chunk`, suffix buckets otherwise)
+        # width under `prefill_chunk`, suffix buckets otherwise).
+        # Paged mode compiles its OWN closed set instead: one decode
+        # per table bucket, one chunk per (width, table bucket), one
+        # gather/scatter per table bucket (preempt/resume), one
+        # resume-state select.
         self._init_jit = jax.jit(init_state)
-        self._prefill_jit = jax.jit(
-            prefill, donate_argnums=(1, 2, 3, 4, 9)
-        )  # args: w, caches, lengths, last, temps, rows, p_lens,
-        #         admit, new_temps, key
-        self._decode_jit = jax.jit(decode, donate_argnums=(1, 2, 3, 6))
-        self._chunk_jit = jax.jit(
-            chunk_step, donate_argnums=(1, 2, 3, 4, 15),
-            static_argnums=(16,),
-        )  # args: w, caches, lengths, last, temps, tokens, offs,
-        #         clens, act, fin, p_lens, new_temps, src_idx,
-        #         copy_mask, copy_len, key, has_copy (static)
-        self._copy_jit = jax.jit(copy_prefix, donate_argnums=(0,))
+        if self.paged:
+            self._paged_decode_jit = jax.jit(
+                paged_decode, donate_argnums=(1, 3, 4, 7)
+            )  # args: w, caches, tables, lengths, last, temps,
+            #         active, key
+            self._paged_chunk_jit = jax.jit(
+                paged_chunk_step, donate_argnums=(1, 8, 9, 10, 13)
+            )  # args: w, caches, tables, tokens, offs, clens, act,
+            #         fin, lengths, last, temps, p_lens, new_temps, key
+            self._gather_jit = jax.jit(offload_rows)
+            self._scatter_jit = jax.jit(
+                restore_rows, donate_argnums=(0,)
+            )
+            self._resume_state_jit = jax.jit(
+                resume_state, donate_argnums=(0, 1, 2)
+            )
+        else:
+            self._prefill_jit = jax.jit(
+                prefill, donate_argnums=(1, 2, 3, 4, 9)
+            )  # args: w, caches, lengths, last, temps, rows, p_lens,
+            #         admit, new_temps, key
+            self._decode_jit = jax.jit(
+                decode, donate_argnums=(1, 2, 3, 6)
+            )
+            self._chunk_jit = jax.jit(
+                chunk_step, donate_argnums=(1, 2, 3, 4, 15),
+                static_argnums=(16,),
+            )  # args: w, caches, lengths, last, temps, tokens, offs,
+            #         clens, act, fin, p_lens, new_temps, src_idx,
+            #         copy_mask, copy_len, key, has_copy (static)
+            self._copy_jit = jax.jit(copy_prefix, donate_argnums=(0,))
 
         self.refresh_weights()
         self._caches, self._lengths, self._last, self._temps = (
@@ -464,6 +695,11 @@ class InferenceEngine:
         self._active_host = np.zeros((self.num_slots,), bool)
         self._active_dev = self._stage_slots(self._active_host.copy())
         self._active_dirty = False
+        # paged staging: device block tables rebuilt only when the
+        # scheduler's tables change or the bucket shifts, plus the
+        # host store of offloaded (preempted) requests' K/V
+        self._tables_cache: tuple | None = None
+        self._offloaded: dict[int, _OffloadRecord] = {}
 
     # -- device staging ------------------------------------------------
 
@@ -531,13 +767,21 @@ class InferenceEngine:
 
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0, eos_id: int | None = None,
-               on_token=None) -> Request:
+               on_token=None, priority: int = 0) -> Request:
         """Queue one generation request (admitted at the next step —
         submission is legal at any time, including mid-flight). Every
         gang process must submit the identical sequence of requests.
         ``on_token(token, done)`` streams tokens to the caller as they
         land; a raising callback fails only ITS request (``req.error``
-        set, KV slot reclaimed) — the engine keeps serving."""
+        set, KV slot reclaimed) — the engine keeps serving.
+        ``priority`` matters only with ``preemption=True``: an arrival
+        may swap out active requests of strictly lower priority when
+        the block pool is exhausted.
+
+        Paged mode: a request whose prompt + budget can NEVER fit the
+        block pool is rejected loudly but GRACEFULLY — ``req.error``
+        set, ``req.done`` True, never queued — instead of raising or
+        (worse) wedging the queue head forever at admission."""
         prompt = np.asarray(prompt).reshape(-1)
         p = len(prompt)
         if p < 1:
@@ -559,9 +803,29 @@ class InferenceEngine:
             self.scheduler.bucket_for(p)
         req = self.scheduler.make_request(
             prompt, max_new_tokens, temperature=temperature, eos_id=eos_id,
-            on_token=on_token,
+            on_token=on_token, priority=priority,
         )
         req.submit_time = time.perf_counter()
+        if self.paged:
+            need = blocks_for(p + max_new_tokens, self.block_size)
+            if need > self.num_blocks:
+                # ISSUE 7 satellite: this request could sit at the
+                # queue head forever (admission can never free enough
+                # blocks) — reject it now, loudly, without poisoning
+                # the engine for everyone behind it
+                req.error = RuntimeError(
+                    f"request {req.rid} needs {need} KV blocks "
+                    f"(prompt {p} + max_new_tokens {max_new_tokens} "
+                    f"at block_size {self.block_size}) but the pool "
+                    f"only has {self.num_blocks} — it can never be "
+                    f"admitted; rejected at submit"
+                )
+                req.done = True
+                self._m_rejected.inc()
+                logger.warning("%s", req.error)
+                self.finished[req.rid] = req
+                self._evict_finished()
+                return req
         self.scheduler.submit(req)
         return req
 
@@ -759,16 +1023,29 @@ class InferenceEngine:
             new_temps[slot] = req.temperature
             if done_prefill:
                 finalized.append(adm)
-        (self._caches, self._lengths, self._last, self._temps,
-         self._key, firsts) = self._chunk_jit(
-            self._weights, self._caches, self._lengths, self._last,
-            self._temps, self._stage_slots(rows),
-            self._stage_slots(offs), self._stage_slots(clens),
-            self._stage_slots(act), self._stage_slots(fin),
-            self._stage_slots(p_lens), self._stage_slots(new_temps),
-            self._stage_slots(src), self._stage_slots(cmask),
-            self._stage_slots(clen), self._key, bool(copies),
-        )
+        if self.paged:
+            # paged chunk: the block tables carry the storage mapping
+            # (incl. any spliced prefix blocks) — no copy vectors
+            (self._caches, self._lengths, self._last, self._temps,
+             self._key, firsts) = self._paged_chunk_jit(
+                self._weights, self._caches, self._staged_tables(),
+                self._stage_slots(rows), self._stage_slots(offs),
+                self._stage_slots(clens), self._stage_slots(act),
+                self._stage_slots(fin), self._lengths, self._last,
+                self._temps, self._stage_slots(p_lens),
+                self._stage_slots(new_temps), self._key,
+            )
+        else:
+            (self._caches, self._lengths, self._last, self._temps,
+             self._key, firsts) = self._chunk_jit(
+                self._weights, self._caches, self._lengths, self._last,
+                self._temps, self._stage_slots(rows),
+                self._stage_slots(offs), self._stage_slots(clens),
+                self._stage_slots(act), self._stage_slots(fin),
+                self._stage_slots(p_lens), self._stage_slots(new_temps),
+                self._stage_slots(src), self._stage_slots(cmask),
+                self._stage_slots(clen), self._key, bool(copies),
+            )
         emitted = []
         if finalized:
             toks = self._host(firsts)
@@ -784,6 +1061,140 @@ class InferenceEngine:
                 self._set_active(adm.slot, True)
                 self._emit(req, int(toks[adm.slot]))
                 emitted.append((req, req.tokens[-1], req.done))
+        return emitted
+
+    # -- paged execution (ISSUE 7) -------------------------------------
+
+    def _staged_tables(self):
+        """Device copy of the scheduler's block tables, ``[num_slots,
+        T]`` for the bucketed ``T`` covering the longest live table —
+        rebuilt only when tables mutate or the bucket shifts. Rows pad
+        with the sentinel id ``num_blocks`` (matches no pool row);
+        idle slots are all-sentinel."""
+        sched = self.scheduler
+        need = max(
+            (len(t) for t in sched.tables.values()), default=1
+        )
+        T = table_bucket_for(need, self._tbuckets)
+        key = (sched.tables_version, T)
+        if self._tables_cache is None or self._tables_cache[0] != key:
+            arr = np.full((self.num_slots, T), self.num_blocks, np.int32)
+            for slot, table in sched.tables.items():
+                arr[slot, : len(table)] = table
+            self._tables_cache = (key, self._stage_slots(arr))
+        return self._tables_cache[1]
+
+    def _pad_ids(self, blocks):
+        """Block ids padded to their table bucket with the sentinel —
+        gather/scatter programs compile once per bucket, not per
+        count."""
+        Tb = table_bucket_for(max(1, len(blocks)), self._tbuckets)
+        ids = np.full((Tb,), self.num_blocks, np.int32)
+        ids[: len(blocks)] = blocks
+        return ids
+
+    def _offload(self, pre) -> None:
+        """Swap a preemption victim's K/V blocks to host memory. MUST
+        run before any pool-writing program of the same step: the
+        scheduler already re-leased the blocks on paper, but the device
+        rows stay intact until the next write, and the gather is
+        dispatched against the CURRENT pool value (the jit data
+        dependency keeps it ordered before any donating consumer)."""
+        req = pre.req
+        with self._tracer.span(
+            "serve.preempt", req=req.rid, blocks=len(pre.blocks),
+        ):
+            ids = self._pad_ids(pre.blocks)
+            rows = self._gather_jit(self._caches, self._stage(ids))
+            n = len(pre.blocks)
+            host = {
+                name: (
+                    np.asarray(self._host(k))[:n].copy(),
+                    np.asarray(self._host(v))[:n].copy(),
+                )
+                for name, (k, v) in rows.items()
+            }
+            self._offloaded[req.rid] = _OffloadRecord(
+                rows=host, n_blocks=n, cur_len=pre.cur_len,
+            )
+        self._set_active(pre.slot, False)
+        self._m_preemptions.inc()
+        self._m_offload_blocks.inc(n)
+        logger.info(
+            "preempted request %d (priority %d): %d blocks offloaded "
+            "to host, slot %d freed", req.rid, req.priority, n, pre.slot,
+        )
+
+    def _resume(self, adm: Admission) -> None:
+        """Restore an offloaded request into its fresh allocation:
+        scatter the host rows into the new table's leading blocks and
+        re-arm the slot's cursor/last-token/temperature. Bit-exact —
+        the restored rows are bitwise the offloaded ones and greedy
+        decode is a pure function of (weights, K/V, cursor, last)."""
+        req = adm.req
+        store = self._offloaded.pop(req.rid)
+        with self._tracer.span(
+            "serve.resume", req=req.rid, blocks=store.n_blocks,
+        ):
+            n = store.n_blocks
+            ids = self._pad_ids(adm.blocks[:n])
+            Tb = len(ids)
+            rows = {}
+            for name, (hk, hv) in store.rows.items():
+                pk = np.zeros((Tb,) + hk.shape[1:], hk.dtype)
+                pv = np.zeros((Tb,) + hv.shape[1:], hv.dtype)
+                pk[:n], pv[:n] = hk, hv
+                rows[name] = (self._stage(pk), self._stage(pv))
+            self._caches = self._scatter_jit(
+                self._caches, self._stage(ids), rows
+            )
+            mask = np.zeros((self.num_slots,), bool)
+            mask[adm.slot] = True
+            r_len = np.zeros((self.num_slots,), np.int32)
+            r_len[adm.slot] = store.cur_len
+            r_last = np.zeros((self.num_slots,), np.int32)
+            r_last[adm.slot] = req.tokens[-1]
+            r_temps = np.zeros((self.num_slots,), np.float32)
+            r_temps[adm.slot] = req.temperature
+            self._lengths, self._last, self._temps = (
+                self._resume_state_jit(
+                    self._lengths, self._last, self._temps,
+                    self._stage_slots(mask), self._stage_slots(r_len),
+                    self._stage_slots(r_last),
+                    self._stage_slots(r_temps),
+                )
+            )
+        self._set_active(adm.slot, True)
+        self._m_resumes.inc()
+        logger.info(
+            "resumed request %d into slot %d (%d blocks restored, "
+            "cursor %d)", req.rid, adm.slot, n, store.cur_len,
+        )
+
+    def _admit_wave_paged(self, plan: list[Admission]):
+        """Execute one paged admission wave: resumes restore their
+        offloaded state (no prefill), fresh admissions prefill their
+        un-shared suffix through the paged chunk program — whole
+        suffix in one bucketed-width call, or budgeted chunks under
+        ``prefill_chunk``. Prefix hits need NO device copy: the shared
+        blocks already sit in the slot's table."""
+        emitted: list[tuple[Request, int, bool]] = []
+        for a in plan:
+            if a.resume is not None:
+                self._resume(a)
+        fresh = [a for a in plan if a.resume is None]
+        if self.prefill_chunk:
+            for a in fresh:
+                self._prefilling[a.slot] = [a, a.shared_len]
+            return emitted
+        by_width: dict[int, list] = {}
+        for a in fresh:
+            suffix = len(a.req.prompt) - a.shared_len
+            by_width.setdefault(
+                self.scheduler.bucket_for(suffix), []
+            ).append((a, a.shared_len, suffix))
+        for width in sorted(by_width):
+            emitted.extend(self._run_chunk(by_width[width], width))
         return emitted
 
     def _admit_wave(self, plan: list[Admission]):
@@ -884,11 +1295,24 @@ class InferenceEngine:
         token, so stream consumers can stop at it without dropping
         tokens."""
         emitted: list[tuple[Request, int, bool]] = []
-        plan = self.scheduler.admit()
-        if plan:
-            # admission emissions land before any decode token, so
-            # req.done there is the prefill token's own flag
-            emitted.extend(self._admit_wave(plan))
+        if self.paged:
+            plan, preempts = self.scheduler.admit_paged(
+                prefilling=frozenset(self._prefilling)
+            )
+            # offloads FIRST: victims' device rows must be read before
+            # any admission's prefill (or resume scatter) writes the
+            # pool — the gather is dispatched against the current pool
+            # value, so ordering here is the whole correctness story
+            for pre in preempts:
+                self._offload(pre)
+            if plan:
+                emitted.extend(self._admit_wave_paged(plan))
+        else:
+            plan = self.scheduler.admit()
+            if plan:
+                # admission emissions land before any decode token, so
+                # req.done there is the prefill token's own flag
+                emitted.extend(self._admit_wave(plan))
         emitted.extend(self._prefill_progress())
         if not any(
             slot not in self._prefilling for slot in self.scheduler.active
@@ -899,11 +1323,20 @@ class InferenceEngine:
             "serve.decode_window", steps=self.steps_per_sync,
             active=len(self.scheduler.active),
         ):
-            (self._caches, self._lengths, self._last, self._key,
-             window) = self._decode_jit(
-                self._weights, self._caches, self._lengths, self._last,
-                self._temps, self._sync_active(), self._key,
-            )
+            if self.paged:
+                (self._caches, self._lengths, self._last, self._key,
+                 window) = self._paged_decode_jit(
+                    self._weights, self._caches, self._staged_tables(),
+                    self._lengths, self._last, self._temps,
+                    self._sync_active(), self._key,
+                )
+            else:
+                (self._caches, self._lengths, self._last, self._key,
+                 window) = self._decode_jit(
+                    self._weights, self._caches, self._lengths,
+                    self._last, self._temps, self._sync_active(),
+                    self._key,
+                )
             toks = self._host(window)  # [steps_per_sync, num_slots]
             for i in range(self.steps_per_sync):
                 if not self.scheduler.active:
@@ -1007,6 +1440,23 @@ class InferenceEngine:
             except Exception:  # pragma: no cover - jax-version drift
                 return -1
 
+        if self.paged:
+            return {
+                # paged closed set: one decode per table bucket, one
+                # chunk per (width, table bucket), gather/scatter per
+                # bucket touched by preemption
+                "decode_compiles": n(self._paged_decode_jit),
+                "prefill_compiles": 0,
+                "chunk_prefill_compiles": n(self._paged_chunk_jit),
+                "copy_compiles": 0,  # prefix hits are table splices
+                "offload_compiles": n(self._gather_jit),
+                "resume_compiles": n(self._scatter_jit),
+                "buckets": tuple(self.scheduler.buckets),
+                "table_buckets": tuple(self._tbuckets),
+                "prefill_chunk": self.prefill_chunk,
+                "block_size": self.block_size,
+                "num_blocks": self.num_blocks,
+            }
         return {
             "decode_compiles": n(self._decode_jit),
             "prefill_compiles": n(self._prefill_jit),
@@ -1052,7 +1502,24 @@ class InferenceEngine:
             "num_slots": self.num_slots,
             "ttft_s": self._percentiles(ttfts),
             "inter_token_s": self._percentiles(itls),
+            # ISSUE 7 satellite: gauge/counter-backed so stats() and a
+            # /metrics scrape can never drift (one store, two views)
+            "queue_depth": int(self.scheduler._m_waiting.value),
+            "preemptions": int(self._m_preemptions.value),
+            "resumes": int(self._m_resumes.value),
+            "rejected": int(self._m_rejected.value),
         }
+        if self.paged:
+            alloc = self.scheduler.allocator
+            out["blocks_total"] = self.num_blocks
+            out["blocks_free"] = alloc.free_count
+            out["offloaded_blocks"] = int(self._m_offload_blocks.value)
+            idx = self.scheduler.prefix_index
+            out["prefix_blocks_shared"] = (
+                idx.shared_blocks if idx is not None else 0
+            )
+            if idx is not None:
+                out["prefix_cache"] = idx.stats()
         if self.scheduler.prefix_cache is not None:
             out["prefix_cache"] = self.scheduler.prefix_cache.stats()
         return out

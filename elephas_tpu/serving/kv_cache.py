@@ -157,6 +157,45 @@ def _slice_seq_at_position_matrix(a, pos_mat, maxlen):
     return a
 
 
+def _graph_replay(model, w, x, attn_fn, slice_fn):
+    """Shared graph-replay scaffold for every serving program, fixed
+    arena and paged alike (ISSUE 7 refactor): FlashMHA ops route to
+    ``attn_fn(op)`` — the program's attention closure, the ONLY part
+    that differs between decode / prefill / chunk / paged variants —
+    Dropout is identity, and every other op runs stateless with ``w``'s
+    weights after ``slice_fn`` re-slices any concrete graph constant
+    spanning the sequence axis (positional tables etc.)."""
+    import keras
+
+    FlashMHA = _flash_mha_layer()
+
+    def handler(op):
+        if isinstance(op, FlashMHA):
+            return attn_fn(op)
+        if isinstance(op, keras.layers.Dropout):
+            return lambda x, *a, **k: x
+        if isinstance(op, keras.Layer) and op.variables:
+            def stateless(*args, _op=op, **kwargs):
+                if kwargs.get("training"):
+                    kwargs["training"] = False
+                args = [slice_fn(a) for a in args]
+                tv = [w[v.path] for v in _op.trainable_variables]
+                ntv = [w[v.path] for v in _op.non_trainable_variables]
+                out, _ = _op.stateless_call(tv, ntv, *args, **kwargs)
+                return out
+
+            return stateless
+
+        def weightless(*args, _op=op, **kwargs):
+            args = [slice_fn(a) for a in args]
+            kwargs = {kk: slice_fn(vv) for kk, vv in kwargs.items()}
+            return _op(*args, **kwargs)
+
+        return weightless
+
+    return model._run_through_graph(x, operation_fn=handler)
+
+
 class SlotKVCache:
     """Specs + sharding rules for the slot arena of one model.
 
@@ -253,9 +292,6 @@ def token_decode_step(model, w, tok, positions, caches, maxlen,
     import jax
     import jax.numpy as jnp
 
-    import keras
-
-    FlashMHA = _flash_mha_layer()
     ctx_new = {}
     # write cursor as a one-hot over the sequence axis: the cache write
     # becomes an elementwise select (slot-local under the mesh — a
@@ -266,75 +302,49 @@ def token_decode_step(model, w, tok, positions, caches, maxlen,
     if active is not None:
         write_mask = write_mask & active[:, None, None, None]
 
-    def handler(op):
-        if isinstance(op, FlashMHA):
-            def attn(x, *_a, **_k):
-                ck, cv = caches[op.name]
-                H, Dh = op.num_heads, op.head_dim
-                qkv = x @ w[op.qkv.kernel.path]  # [B, 3·H·Dh]
-                q, k, v = jnp.split(
-                    qkv.reshape(x.shape[0], 3, H, Dh), 3, axis=1
-                )
-                q, k, v = q[:, 0], k[:, 0], v[:, 0]  # [B, H, Dh]
-                if getattr(op, "rope", False):
-                    cos_np, sin_np = _rope_tables(maxlen, Dh)
-                    cos_t = _rows_at_positions(
-                        jnp.asarray(cos_np), positions
-                    )[:, None, :]
-                    sin_t = _rows_at_positions(
-                        jnp.asarray(sin_np), positions
-                    )[:, None, :]
-                    q = _apply_rope(q, cos_t, sin_t)
-                    k = _apply_rope(k, cos_t, sin_t)
-                ck = jnp.where(write_mask, k[:, None], ck)
-                cv = jnp.where(write_mask, v[:, None], cv)
-                att = jnp.einsum("bhd,bshd->bhs", q, ck) * (Dh**-0.5)
-                visible = (
-                    jnp.arange(maxlen)[None, None, :]
-                    <= positions[:, None, None]
-                )
-                att = jax.nn.softmax(
-                    jnp.where(visible, att, -jnp.inf), axis=-1
-                )
-                o = jnp.einsum("bhs,bshd->bhd", att, cv).reshape(
-                    x.shape[0], H * Dh
-                )
-                ctx_new[op.name] = (ck, cv)
-                return (
-                    o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
-                )
+    def attn_for(op):
+        def attn(x, *_a, **_k):
+            ck, cv = caches[op.name]
+            H, Dh = op.num_heads, op.head_dim
+            qkv = x @ w[op.qkv.kernel.path]  # [B, 3·H·Dh]
+            q, k, v = jnp.split(
+                qkv.reshape(x.shape[0], 3, H, Dh), 3, axis=1
+            )
+            q, k, v = q[:, 0], k[:, 0], v[:, 0]  # [B, H, Dh]
+            if getattr(op, "rope", False):
+                cos_np, sin_np = _rope_tables(maxlen, Dh)
+                cos_t = _rows_at_positions(
+                    jnp.asarray(cos_np), positions
+                )[:, None, :]
+                sin_t = _rows_at_positions(
+                    jnp.asarray(sin_np), positions
+                )[:, None, :]
+                q = _apply_rope(q, cos_t, sin_t)
+                k = _apply_rope(k, cos_t, sin_t)
+            ck = jnp.where(write_mask, k[:, None], ck)
+            cv = jnp.where(write_mask, v[:, None], cv)
+            att = jnp.einsum("bhd,bshd->bhs", q, ck) * (Dh**-0.5)
+            visible = (
+                jnp.arange(maxlen)[None, None, :]
+                <= positions[:, None, None]
+            )
+            att = jax.nn.softmax(
+                jnp.where(visible, att, -jnp.inf), axis=-1
+            )
+            o = jnp.einsum("bhs,bshd->bhd", att, cv).reshape(
+                x.shape[0], H * Dh
+            )
+            ctx_new[op.name] = (ck, cv)
+            return (
+                o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
+            )
 
-            return attn
-        if isinstance(op, keras.layers.Dropout):
-            return lambda x, *a, **k: x
-        if isinstance(op, keras.Layer) and op.variables:
-            def stateless(*args, _op=op, **kwargs):
-                if kwargs.get("training"):
-                    kwargs["training"] = False
-                args = [
-                    _slice_seq_at_positions(a, positions, maxlen)
-                    for a in args
-                ]
-                tv = [w[v.path] for v in _op.trainable_variables]
-                ntv = [w[v.path] for v in _op.non_trainable_variables]
-                out, _ = _op.stateless_call(tv, ntv, *args, **kwargs)
-                return out
+        return attn
 
-            return stateless
-
-        def weightless(*args, _op=op, **kwargs):
-            args = [
-                _slice_seq_at_positions(a, positions, maxlen) for a in args
-            ]
-            kwargs = {
-                kk: _slice_seq_at_positions(vv, positions, maxlen)
-                for kk, vv in kwargs.items()
-            }
-            return _op(*args, **kwargs)
-
-        return weightless
-
-    logits = model._run_through_graph(tok, operation_fn=handler)
+    logits = _graph_replay(
+        model, w, tok, attn_for,
+        lambda a: _slice_seq_at_positions(a, positions, maxlen),
+    )
     return logits, {
         name: ctx_new.get(name, caches[name]) for name in caches
     }
@@ -360,87 +370,63 @@ def prefill_forward(model, w, tokens_rows, caches, admit_mask, maxlen):
     import jax
     import jax.numpy as jnp
 
-    import keras
-
-    FlashMHA = _flash_mha_layer()
     ctx_new = {}
     S = int(tokens_rows.shape[1])
 
-    def handler(op):
-        if isinstance(op, FlashMHA):
-            def attn(x, *_a, **_k):
-                ck, cv = caches[op.name]
-                H, Dh = op.num_heads, op.head_dim
-                B = x.shape[0]
-                qkv = jnp.reshape(
-                    x @ w[op.qkv.kernel.path], (B, S, 3, H, Dh)
-                )
-                qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3,B,H,S,Dh]
-                q, k, v = qkv[0], qkv[1], qkv[2]
-                if getattr(op, "rope", False):
-                    cos_np, sin_np = _rope_tables(maxlen, Dh)
-                    cos = jnp.asarray(cos_np)[None, None, :S]
-                    sin = jnp.asarray(sin_np)[None, None, :S]
-                    q = _apply_rope(q, cos, sin)
-                    k = _apply_rope(k, cos, sin)
-                att = jnp.einsum("bhid,bhjd->bhij", q, k) * (Dh**-0.5)
-                causal = (
-                    jnp.arange(S)[None, :] <= jnp.arange(S)[:, None]
-                )[None, None]
-                att = jax.nn.softmax(
-                    jnp.where(causal, att, -jnp.inf), axis=-1
-                )
-                o = jnp.einsum("bhij,bhjd->bhid", att, v)
-                o = jnp.reshape(
-                    jnp.transpose(o, (0, 2, 1, 3)), (B, S, H * Dh)
-                )
-                # per-slot row write as a one-hot select (dynamic
-                # scatter on the SHARDED slot axis would make GSPMD
-                # emit collectives — same reasoning as the decode
-                # cursor): [B, S, H, Dh] rows land where admitted
-                k_rows = jnp.transpose(k, (0, 2, 1, 3))  # [B,S,H,Dh]
-                v_rows = jnp.transpose(v, (0, 2, 1, 3))
-                if S < maxlen:
-                    pad = ((0, 0), (0, maxlen - S), (0, 0), (0, 0))
-                    k_rows = jnp.pad(k_rows, pad)
-                    v_rows = jnp.pad(v_rows, pad)
-                sel = (
-                    admit_mask[:, None]
-                    & (jnp.arange(maxlen) < S)[None, :]
-                )[:, :, None, None]
-                ck = jnp.where(sel, k_rows.astype(ck.dtype), ck)
-                cv = jnp.where(sel, v_rows.astype(cv.dtype), cv)
-                ctx_new[op.name] = (ck, cv)
-                return (
-                    o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
-                )
+    def attn_for(op):
+        def attn(x, *_a, **_k):
+            ck, cv = caches[op.name]
+            H, Dh = op.num_heads, op.head_dim
+            B = x.shape[0]
+            qkv = jnp.reshape(
+                x @ w[op.qkv.kernel.path], (B, S, 3, H, Dh)
+            )
+            qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3,B,H,S,Dh]
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            if getattr(op, "rope", False):
+                cos_np, sin_np = _rope_tables(maxlen, Dh)
+                cos = jnp.asarray(cos_np)[None, None, :S]
+                sin = jnp.asarray(sin_np)[None, None, :S]
+                q = _apply_rope(q, cos, sin)
+                k = _apply_rope(k, cos, sin)
+            att = jnp.einsum("bhid,bhjd->bhij", q, k) * (Dh**-0.5)
+            causal = (
+                jnp.arange(S)[None, :] <= jnp.arange(S)[:, None]
+            )[None, None]
+            att = jax.nn.softmax(
+                jnp.where(causal, att, -jnp.inf), axis=-1
+            )
+            o = jnp.einsum("bhij,bhjd->bhid", att, v)
+            o = jnp.reshape(
+                jnp.transpose(o, (0, 2, 1, 3)), (B, S, H * Dh)
+            )
+            # per-slot row write as a one-hot select (dynamic
+            # scatter on the SHARDED slot axis would make GSPMD
+            # emit collectives — same reasoning as the decode
+            # cursor): [B, S, H, Dh] rows land where admitted
+            k_rows = jnp.transpose(k, (0, 2, 1, 3))  # [B,S,H,Dh]
+            v_rows = jnp.transpose(v, (0, 2, 1, 3))
+            if S < maxlen:
+                pad = ((0, 0), (0, maxlen - S), (0, 0), (0, 0))
+                k_rows = jnp.pad(k_rows, pad)
+                v_rows = jnp.pad(v_rows, pad)
+            sel = (
+                admit_mask[:, None]
+                & (jnp.arange(maxlen) < S)[None, :]
+            )[:, :, None, None]
+            ck = jnp.where(sel, k_rows.astype(ck.dtype), ck)
+            cv = jnp.where(sel, v_rows.astype(cv.dtype), cv)
+            ctx_new[op.name] = (ck, cv)
+            return (
+                o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
+            )
 
-            return attn
-        if isinstance(op, keras.layers.Dropout):
-            return lambda x, *a, **k: x
-        if isinstance(op, keras.Layer) and op.variables:
-            def stateless(*args, _op=op, **kwargs):
-                if kwargs.get("training"):
-                    kwargs["training"] = False
-                args = [_slice_seq_prefix(a, S, maxlen) for a in args]
-                tv = [w[v.path] for v in _op.trainable_variables]
-                ntv = [w[v.path] for v in _op.non_trainable_variables]
-                out, _ = _op.stateless_call(tv, ntv, *args, **kwargs)
-                return out
+        return attn
 
-            return stateless
-
-        def weightless(*args, _op=op, **kwargs):
-            args = [_slice_seq_prefix(a, S, maxlen) for a in args]
-            kwargs = {
-                kk: _slice_seq_prefix(vv, S, maxlen)
-                for kk, vv in kwargs.items()
-            }
-            return _op(*args, **kwargs)
-
-        return weightless
-
-    logits = model._run_through_graph(tokens_rows, operation_fn=handler)
+    logits = _graph_replay(
+        model, w, tokens_rows, attn_for,
+        lambda a: _slice_seq_prefix(a, S, maxlen),
+    )
     return logits, {
         name: ctx_new.get(name, caches[name]) for name in caches
     }
@@ -477,9 +463,6 @@ def chunked_prefill_forward(model, w, tokens_chunk, caches, offsets,
     import jax
     import jax.numpy as jnp
 
-    import keras
-
-    FlashMHA = _flash_mha_layer()
     ctx_new = {}
     C = int(tokens_chunk.shape[1])
     # absolute positions of each slot's chunk rows, and the cache-write
@@ -494,90 +477,63 @@ def chunked_prefill_forward(model, w, tokens_chunk, caches, offsets,
         pos_mat[:, None, :] == jnp.arange(maxlen)[None, :, None]
     ) & valid[:, None, :]  # [B, maxlen, C]
 
-    def handler(op):
-        if isinstance(op, FlashMHA):
-            def attn(x, *_a, **_k):
-                ck, cv = caches[op.name]
-                H, Dh = op.num_heads, op.head_dim
-                B = x.shape[0]
-                qkv = jnp.reshape(
-                    x @ w[op.qkv.kernel.path], (B, C, 3, H, Dh)
-                )
-                qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3,B,H,C,Dh]
-                q, k, v = qkv[0], qkv[1], qkv[2]
-                if getattr(op, "rope", False):
-                    cos_np, sin_np = _rope_tables(maxlen, Dh)
-                    cos = _rows_at_position_matrix(
-                        jnp.asarray(cos_np), pos_mat
-                    )[:, None]  # [B, 1, C, Dh]
-                    sin = _rows_at_position_matrix(
-                        jnp.asarray(sin_np), pos_mat
-                    )[:, None]
-                    q = _apply_rope(q, cos, sin)
-                    k = _apply_rope(k, cos, sin)
-                # land this chunk's K/V rows FIRST, then attend over the
-                # updated arena row — queries see the prefix copy,
-                # earlier chunks, and their own chunk's causal part
-                k_rows = jnp.transpose(k, (0, 2, 1, 3))  # [B, C, H, Dh]
-                v_rows = jnp.transpose(v, (0, 2, 1, 3))
-                scat_k = jnp.einsum(
-                    "bsc,bchd->bshd", write_sel.astype(ck.dtype), k_rows
-                )
-                scat_v = jnp.einsum(
-                    "bsc,bchd->bshd", write_sel.astype(cv.dtype), v_rows
-                )
-                covered = jnp.any(write_sel, axis=2)[:, :, None, None]
-                ck = jnp.where(covered, scat_k, ck)
-                cv = jnp.where(covered, scat_v, cv)
-                att = jnp.einsum("bhcd,bshd->bhcs", q, ck) * (Dh**-0.5)
-                visible = (
-                    jnp.arange(maxlen)[None, None, None, :]
-                    <= pos_mat[:, None, :, None]
-                )
-                att = jax.nn.softmax(
-                    jnp.where(visible, att, -jnp.inf), axis=-1
-                )
-                o = jnp.einsum("bhcs,bshd->bhcd", att, cv)
-                o = jnp.reshape(
-                    jnp.transpose(o, (0, 2, 1, 3)), (B, C, H * Dh)
-                )
-                ctx_new[op.name] = (ck, cv)
-                return (
-                    o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
-                )
+    def attn_for(op):
+        def attn(x, *_a, **_k):
+            ck, cv = caches[op.name]
+            H, Dh = op.num_heads, op.head_dim
+            B = x.shape[0]
+            qkv = jnp.reshape(
+                x @ w[op.qkv.kernel.path], (B, C, 3, H, Dh)
+            )
+            qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3,B,H,C,Dh]
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            if getattr(op, "rope", False):
+                cos_np, sin_np = _rope_tables(maxlen, Dh)
+                cos = _rows_at_position_matrix(
+                    jnp.asarray(cos_np), pos_mat
+                )[:, None]  # [B, 1, C, Dh]
+                sin = _rows_at_position_matrix(
+                    jnp.asarray(sin_np), pos_mat
+                )[:, None]
+                q = _apply_rope(q, cos, sin)
+                k = _apply_rope(k, cos, sin)
+            # land this chunk's K/V rows FIRST, then attend over the
+            # updated arena row — queries see the prefix copy,
+            # earlier chunks, and their own chunk's causal part
+            k_rows = jnp.transpose(k, (0, 2, 1, 3))  # [B, C, H, Dh]
+            v_rows = jnp.transpose(v, (0, 2, 1, 3))
+            scat_k = jnp.einsum(
+                "bsc,bchd->bshd", write_sel.astype(ck.dtype), k_rows
+            )
+            scat_v = jnp.einsum(
+                "bsc,bchd->bshd", write_sel.astype(cv.dtype), v_rows
+            )
+            covered = jnp.any(write_sel, axis=2)[:, :, None, None]
+            ck = jnp.where(covered, scat_k, ck)
+            cv = jnp.where(covered, scat_v, cv)
+            att = jnp.einsum("bhcd,bshd->bhcs", q, ck) * (Dh**-0.5)
+            visible = (
+                jnp.arange(maxlen)[None, None, None, :]
+                <= pos_mat[:, None, :, None]
+            )
+            att = jax.nn.softmax(
+                jnp.where(visible, att, -jnp.inf), axis=-1
+            )
+            o = jnp.einsum("bhcs,bshd->bhcd", att, cv)
+            o = jnp.reshape(
+                jnp.transpose(o, (0, 2, 1, 3)), (B, C, H * Dh)
+            )
+            ctx_new[op.name] = (ck, cv)
+            return (
+                o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
+            )
 
-            return attn
-        if isinstance(op, keras.layers.Dropout):
-            return lambda x, *a, **k: x
-        if isinstance(op, keras.Layer) and op.variables:
-            def stateless(*args, _op=op, **kwargs):
-                if kwargs.get("training"):
-                    kwargs["training"] = False
-                args = [
-                    _slice_seq_at_position_matrix(a, pos_mat, maxlen)
-                    for a in args
-                ]
-                tv = [w[v.path] for v in _op.trainable_variables]
-                ntv = [w[v.path] for v in _op.non_trainable_variables]
-                out, _ = _op.stateless_call(tv, ntv, *args, **kwargs)
-                return out
+        return attn
 
-            return stateless
-
-        def weightless(*args, _op=op, **kwargs):
-            args = [
-                _slice_seq_at_position_matrix(a, pos_mat, maxlen)
-                for a in args
-            ]
-            kwargs = {
-                kk: _slice_seq_at_position_matrix(vv, pos_mat, maxlen)
-                for kk, vv in kwargs.items()
-            }
-            return _op(*args, **kwargs)
-
-        return weightless
-
-    logits = model._run_through_graph(tokens_chunk, operation_fn=handler)
+    logits = _graph_replay(
+        model, w, tokens_chunk, attn_for,
+        lambda a: _slice_seq_at_position_matrix(a, pos_mat, maxlen),
+    )
     return logits, {
         name: ctx_new.get(name, caches[name]) for name in caches
     }

@@ -36,6 +36,7 @@ bookkeeping about which rows are still meaningful.
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass, field
 
 from elephas_tpu import telemetry
@@ -291,4 +292,268 @@ class PrefixCache:
             "misses": self.misses,
             "reused_tokens": self.reused_tokens,
             "evictions": self.evictions,
+        }
+
+
+@dataclass
+class BlockEntry:
+    """One indexed full-block prompt prefix (paged mode, ISSUE 7):
+    ``blocks[i]`` holds the K/V of ``tokens[i·bs : (i+1)·bs]``. The
+    entry owns one allocator reference per block, independent of the
+    request that prefilled them — the request can finish, be preempted,
+    or free its table without invalidating the entry."""
+
+    eid: int
+    tokens: tuple
+    blocks: tuple
+    last_use: int
+
+
+class PagedPrefixIndex:
+    """Radix index over FULL-BLOCK prompt prefixes for the paged arena
+    (ISSUE 7) — the block-refcount successor of :class:`PrefixCache`'s
+    donor-slot scheme. Entries hold block-id lists instead of slots, so
+
+    - a prefix hit is a COPY-FREE block-table splice: the shared blocks
+      join the new request's table with one more reference each — no
+      device copy program, no donor gather, and the "donor" never
+      occupies a decode slot;
+    - sharing is at full-block granularity only (a partially-filled
+      block also holds the writer's later tokens, so splicing it would
+      let the sharer read rows it must instead compute — the trailing
+      ``len(prompt) % block_size`` tokens of a hit re-prefill with the
+      suffix);
+    - eviction under pool pressure (:meth:`evict_for`) drops LRU
+      entries whose blocks would actually free (refcount 1) —
+      releasing an entry shared with live tables frees nothing and is
+      skipped.
+
+    Same determinism rules as :class:`PrefixCache`: logical clock
+    recency, entry-id tie-breaks, :meth:`match` is PURE (commit happens
+    only when the admission lands)."""
+
+    def __init__(self, allocator):
+        self._alloc = allocator
+        self._root = _Node()  # node.slots holds entry ids here
+        self._entries: dict[int, BlockEntry] = {}
+        self._by_tokens: dict[tuple, BlockEntry] = {}
+        self._clock = 0
+        self._ids = itertools.count()
+        reg = telemetry.registry()
+        cid = telemetry.instance_label()
+        self.telemetry_label = cid
+
+        def _c(name, help_):
+            return reg.counter(
+                name, help_, labels=("cache",)
+            ).labels(cache=cid)
+
+        self._m_hits = _c(
+            "elephas_prefix_cache_hits_total",
+            "Admissions served a donor copy from the prefix cache",
+        )
+        self._m_misses = _c(
+            "elephas_prefix_cache_misses_total",
+            "Admissions that landed cold (no usable cached prefix)",
+        )
+        self._m_reused_tokens = _c(
+            "elephas_prefix_cache_reused_tokens_total",
+            "Prompt tokens served by donor copy instead of prefill",
+        )
+        self._m_evictions = _c(
+            "elephas_prefix_cache_evictions_total",
+            "Donor entries evicted under slot pressure (LRU)",
+        )
+        self._m_shared_blocks = _c(
+            "elephas_prefix_blocks_shared_total",
+            "Pool blocks spliced copy-free into admitted requests' "
+            "block tables via prefix-index refcount sharing",
+        )
+
+    # registry-backed counter views (same contract as PrefixCache)
+
+    @property
+    def hits(self) -> int:
+        return int(self._m_hits.value)
+
+    @property
+    def misses(self) -> int:
+        return int(self._m_misses.value)
+
+    @property
+    def reused_tokens(self) -> int:
+        return int(self._m_reused_tokens.value)
+
+    @property
+    def evictions(self) -> int:
+        return int(self._m_evictions.value)
+
+    @property
+    def shared_blocks(self) -> int:
+        return int(self._m_shared_blocks.value)
+
+    def release_telemetry(self) -> None:
+        telemetry.remove_series(cache=self.telemetry_label)
+
+    # -- registration ---------------------------------------------------
+
+    def insert(self, tokens, blocks) -> None:
+        """Index the full-block prefix of ``tokens`` whose K/V lives in
+        ``blocks`` (the owning request's leading table blocks), taking
+        one allocator reference per indexed block. Called when a
+        request's prefill completes — the rows exist and are final from
+        that moment (decode writes only at positions ``>= len(prompt)``)
+        — so sharing starts while the writer still decodes. An exact
+        duplicate bumps recency instead of double-indexing."""
+        bs = self._alloc.block_size
+        n_full = len(tokens) // bs
+        if n_full < 1:
+            return
+        key = tuple(int(t) for t in tokens[: n_full * bs])
+        self._clock += 1
+        prev = self._by_tokens.get(key)
+        if prev is not None:
+            prev.last_use = self._clock
+            return
+        held = tuple(int(b) for b in blocks[:n_full])
+        if len(held) != n_full:
+            raise ValueError(
+                f"insert(): {n_full} full prompt blocks indexed but "
+                f"only {len(held)} block ids supplied"
+            )
+        self._alloc.ref(held)
+        entry = BlockEntry(
+            eid=next(self._ids), tokens=key, blocks=held,
+            last_use=self._clock,
+        )
+        self._entries[entry.eid] = entry
+        self._by_tokens[key] = entry
+        node = self._root
+        for t in key:
+            node = node.children.setdefault(t, _Node())
+            node.slots.add(entry.eid)
+
+    def _remove(self, entry: BlockEntry) -> list[int]:
+        """Drop the entry, prune its trie path, release its block
+        references. Returns the block ids that actually freed."""
+        del self._entries[entry.eid]
+        del self._by_tokens[entry.tokens]
+        node, path = self._root, []
+        for t in entry.tokens:
+            child = node.children.get(t)
+            if child is None:  # defensive: trie already pruned
+                break
+            path.append((node, t, child))
+            child.slots.discard(entry.eid)
+            node = child
+        for parent, t, child in reversed(path):
+            if not child.slots and not child.children:
+                del parent.children[t]
+        return self._alloc.deref(entry.blocks)
+
+    # -- lookup / splice ------------------------------------------------
+
+    def match(self, prompt):
+        """Longest indexed FULL-BLOCK prefix of ``prompt`` strictly
+        shorter than the prompt (at least one suffix token must remain
+        to prefill). PURE — same contract as :meth:`PrefixCache.match`.
+
+        Returns ``(eid, reuse_tokens)`` (``reuse_tokens`` a multiple of
+        the block size) or ``(None, 0)``."""
+        bs = self._alloc.block_size
+        cap = len(prompt) - 1
+        node, depth = self._root, 0
+        best_node, best_depth = None, 0
+        for t in prompt:
+            if depth >= cap:
+                break
+            node = node.children.get(int(t))
+            if node is None or not node.slots:
+                break
+            depth += 1
+            if depth % bs == 0:
+                # only full-block depths are spliceable: any entry
+                # passing through this node covers >= depth tokens,
+                # hence >= depth/bs whole blocks
+                best_node, best_depth = node, depth
+        if best_node is None:
+            return None, 0
+        eid = max(
+            best_node.slots,
+            key=lambda e: (self._entries[e].last_use, -e),
+        )
+        return eid, best_depth
+
+    def commit_hit(self, eid: int, reuse_len: int) -> list[int]:
+        """The admission lands: reference the entry's first
+        ``reuse_len / bs`` blocks for the new table and return their
+        ids (in prompt order). Bumps recency + hit accounting."""
+        entry = self._entries[eid]
+        self._clock += 1
+        entry.last_use = self._clock
+        n = int(reuse_len) // self._alloc.block_size
+        shared = list(entry.blocks[:n])
+        self._alloc.ref(shared)
+        self._m_hits.inc()
+        self._m_reused_tokens.inc(int(reuse_len))
+        self._m_shared_blocks.inc(n)
+        return shared
+
+    def record_miss(self) -> None:
+        self._m_misses.inc()
+
+    # -- eviction / flush -----------------------------------------------
+
+    def evict_for(self, n_blocks: int) -> int:
+        """Release LRU entries until at least ``n_blocks`` pool blocks
+        freed or nothing more can free. Entries none of whose blocks
+        would free (all still referenced by live tables or longer
+        entries) are skipped — dropping them reclaims nothing and would
+        only forget reusable prefixes. Returns blocks freed."""
+        freed = 0
+        while freed < n_blocks:
+            victims = sorted(
+                self._entries.values(),
+                key=lambda e: (e.last_use, e.eid),
+            )
+            pick = next(
+                (
+                    e for e in victims
+                    if any(
+                        self._alloc.ref_count(b) == 1 for b in e.blocks
+                    )
+                ),
+                None,
+            )
+            if pick is None:
+                break
+            freed += len(self._remove(pick))
+            self._m_evictions.inc()
+        return freed
+
+    def flush(self) -> None:
+        """Drop EVERY entry and release its block references (weight
+        refresh: indexed rows were computed under the old weights — a
+        splice would silently mix weight generations)."""
+        for eid in list(self._entries):
+            entry = self._entries.get(eid)
+            if entry is not None:
+                self._remove(entry)
+
+    # -- introspection --------------------------------------------------
+
+    def entry(self, eid: int) -> BlockEntry | None:
+        return self._entries.get(eid)
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "indexed_blocks": sum(
+                len(e.blocks) for e in self._entries.values()
+            ),
+            "hits": self.hits,
+            "misses": self.misses,
+            "reused_tokens": self.reused_tokens,
+            "evictions": self.evictions,
+            "shared_blocks": self.shared_blocks,
         }

@@ -30,7 +30,8 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from elephas_tpu import telemetry
-from elephas_tpu.serving.prefix_cache import PrefixCache
+from elephas_tpu.serving.paged_kv import blocks_for
+from elephas_tpu.serving.prefix_cache import PagedPrefixIndex, PrefixCache
 
 
 def default_buckets(max_len: int, floor: int = 16) -> tuple[int, ...]:
@@ -74,6 +75,10 @@ class Request:
     max_new_tokens: int
     temperature: float = 0.0
     eos_id: int | None = None
+    # scheduling priority (paged preemption, ISSUE 7): an arriving
+    # request may preempt active requests of STRICTLY lower priority
+    # when the block pool is exhausted; equal priorities never preempt
+    priority: int = 0
     tokens: list = field(default_factory=list)
     slot: int | None = None
     done: bool = False
@@ -111,26 +116,75 @@ class Request:
 class Admission:
     """One admission decision: ``req`` leases ``slot``; when the prefix
     cache found a donor, ``donor_slot``'s first ``reuse_len`` arena
-    rows are copied before the (suffix-only) prefill."""
+    rows are copied before the (suffix-only) prefill.
+
+    Paged mode (ISSUE 7) fills the second group instead: ``blocks`` is
+    the slot's freshly-built block table (shared splice + own
+    allocation), ``shared_len`` the copy-free prefix tokens already
+    resident in the spliced blocks (prefill starts there), and
+    ``resume`` the preemption record when this admission brings an
+    offloaded request back (the engine restores its K/V and cursor
+    instead of prefilling)."""
 
     req: Request
     slot: int
     donor_slot: int | None = None
     reuse_len: int = 0
+    blocks: list | None = None
+    shared_len: int = 0
+    resume: "Preemption | None" = None
+
+
+@dataclass
+class Preemption:
+    """One preemption decision (paged mode): ``req`` lost ``slot``;
+    its first ``len(blocks)`` table blocks hold K/V for positions
+    ``0..cur_len-1`` and must be offloaded to host BEFORE any program
+    writes the pool again (the engine enforces the ordering). The
+    request re-queues at the waiting front and resumes bit-exact."""
+
+    req: Request
+    slot: int
+    blocks: tuple
+    cur_len: int
 
 
 class Scheduler:
     """FIFO queue + slot lease tracking for :class:`InferenceEngine`."""
 
     def __init__(self, num_slots: int, buckets, prefix_cache: bool = False,
-                 prefix_min_reuse: int = 1):
+                 prefix_min_reuse: int = 1, allocator=None,
+                 preemption: bool = False):
         self.num_slots = int(num_slots)
         self.buckets = tuple(sorted(int(b) for b in buckets))
         self.waiting: deque[Request] = deque()
         self.active: dict[int, Request] = {}
         self._free: list[int] = list(range(self.num_slots))
         self._ids = itertools.count()
-        self.prefix_cache = PrefixCache() if prefix_cache else None
+        # paged mode (ISSUE 7): an allocator switches admission from
+        # slot-only leasing to slot+block leasing; the prefix cache
+        # becomes a block-refcount index (copy-free splices) instead of
+        # the donor-slot scheme
+        self.allocator = allocator
+        self.preemption = bool(preemption)
+        if preemption and allocator is None:
+            raise ValueError(
+                "preemption requires the paged allocator — the fixed "
+                "arena has no blocks to swap out"
+            )
+        self.tables: dict[int, list[int]] = {}
+        # bumped on ANY table mutation so the engine can cheaply
+        # invalidate its staged device copy of the block tables
+        self.tables_version = 0
+        self._preempted: dict[int, Preemption] = {}
+        self.prefix_index = (
+            PagedPrefixIndex(allocator)
+            if prefix_cache and allocator is not None else None
+        )
+        self.prefix_cache = (
+            PrefixCache()
+            if prefix_cache and allocator is None else None
+        )
         # matches shallower than this admit COLD: a 1-2 token
         # coincidental prefix is not worth a copy dispatch (and on
         # accidental-hit traffic would drag every admission through
@@ -155,6 +209,9 @@ class Scheduler:
         self._m_admit_hit = admissions.labels(
             scheduler=sid, kind="prefix_hit"
         )
+        self._m_admit_resume = admissions.labels(
+            scheduler=sid, kind="resume"
+        )
         self._m_waiting = reg.gauge(
             "elephas_serving_waiting_requests",
             "Requests queued behind a full slot arena",
@@ -169,6 +226,8 @@ class Scheduler:
         telemetry.remove_series(scheduler=self.telemetry_label)
         if self.prefix_cache is not None:
             self.prefix_cache.release_telemetry()
+        if self.prefix_index is not None:
+            self.prefix_index.release_telemetry()
 
     # -- submission ----------------------------------------------------
 
@@ -179,7 +238,8 @@ class Scheduler:
         return request
 
     def make_request(self, prompt, max_new_tokens, temperature=0.0,
-                     eos_id=None, on_token=None) -> Request:
+                     eos_id=None, on_token=None,
+                     priority: int = 0) -> Request:
         return Request(
             rid=next(self._ids),
             prompt=tuple(int(t) for t in prompt),
@@ -187,6 +247,7 @@ class Scheduler:
             temperature=float(temperature),
             eos_id=None if eos_id is None else int(eos_id),
             on_token=on_token,
+            priority=int(priority),
         )
 
     # -- per-step decisions --------------------------------------------
@@ -259,15 +320,185 @@ class Scheduler:
         self._m_waiting.set(len(self.waiting))
         return admitted
 
+    # -- paged admission (ISSUE 7) --------------------------------------
+
+    def blocks_needed(self, req: Request) -> int:
+        """Full reservation of ``req``: blocks covering prompt + the
+        whole token budget. Reserving up front (vLLM reserves lazily
+        and swaps on OOM) keeps the schedule gang-deterministic and
+        means an admitted request can NEVER hit mid-flight pool
+        exhaustion — preemption happens only at admission boundaries."""
+        return blocks_for(
+            len(req.prompt) + req.max_new_tokens,
+            self.allocator.block_size,
+        )
+
+    def admit_paged(self, prefilling=frozenset()):
+        """Paged admission wave: FIFO head-blocking like :meth:`admit`,
+        but a request needs BOTH a free slot and its full block
+        reservation. Shortfalls resolve in deterministic order: evict
+        LRU prefix-index entries first (cheap — they free whole blocks
+        nobody is decoding with), then, when ``preemption`` is on and
+        the head outranks an active request, preempt victims (lowest
+        priority first, youngest first within a priority) until the
+        head fits — or not at all, if even preempting every eligible
+        victim would not admit it (no thrash for nothing). ``prefilling``
+        slots are never victims (their tables are mid-write).
+
+        Returns ``(admissions, preemptions)``; the engine MUST offload
+        every preemption's blocks before running any pool-writing
+        program, then execute the admissions."""
+        if self.allocator is None:
+            raise RuntimeError("admit_paged() on a non-paged scheduler")
+        admitted: list[Admission] = []
+        preempts: list[Preemption] = []
+        alloc, idx = self.allocator, self.prefix_index
+        while self.waiting:
+            req = self.waiting[0]
+            need_total = self.blocks_needed(req)
+            record = self._preempted.get(req.rid)
+            eid, reuse = None, 0
+            if record is None and idx is not None:
+                # PURE probe; commit only when the admission lands
+                eid, reuse = idx.match(req.prompt)
+                if eid is not None and reuse < self.prefix_min_reuse:
+                    eid, reuse = None, 0
+            own_need = need_total - reuse // alloc.block_size
+            short = own_need - alloc.free_count
+            if short > 0 and idx is not None:
+                idx.evict_for(short)
+                short = own_need - alloc.free_count
+            plan = []
+            if short > 0 or not self._free:
+                if self.preemption:
+                    plan = self._plan_preemption(
+                        req, short, bool(self._free), prefilling
+                    )
+                if not plan:
+                    break  # head keeps waiting; nothing may jump it
+            # the head WILL admit: remove it from the queue BEFORE
+            # executing preemptions, so victims re-queue at the front
+            # of the REMAINING queue (not ahead of the head — that
+            # would make the wave pop the victim instead)
+            self.waiting.popleft()
+            for victim in plan:
+                preempts.append(self._preempt(victim))
+            shared: list[int] = []
+            if eid is not None:
+                shared = idx.commit_hit(eid, reuse)
+            elif idx is not None and record is None:
+                idx.record_miss()
+            own = alloc.alloc(own_need)
+            assert own is not None  # guaranteed by the short check
+            slot = self._free.pop(0)
+            self.tables[slot] = shared + own
+            self.tables_version += 1
+            req.slot = slot
+            self.active[slot] = req
+            if record is not None:
+                self._preempted.pop(req.rid)
+                self._m_admit_resume.inc()
+                admitted.append(Admission(
+                    req=req, slot=slot, blocks=self.tables[slot],
+                    resume=record,
+                ))
+            else:
+                req.reused_tokens = reuse
+                (self._m_admit_hit if eid is not None
+                 else self._m_admit_cold).inc()
+                admitted.append(Admission(
+                    req=req, slot=slot, blocks=self.tables[slot],
+                    shared_len=reuse,
+                ))
+        self._m_waiting.set(len(self.waiting))
+        return admitted, preempts
+
+    def _plan_preemption(self, req: Request, short: int,
+                         have_slot: bool, prefilling):
+        """Choose victims that would admit ``req`` — or none at all.
+        Eligible: active, strictly lower priority, NOT mid-prefill,
+        and holding at least one generated token — a request with no
+        token yet has no resident state an offload could represent
+        (its prefill has not finalized), and crucially that guard
+        covers admissions made EARLIER IN THIS SAME WAVE: their
+        Admission is already in the returned plan, so preempting them
+        would double-lease their blocks and hand the engine a plan
+        that prefills into a revoked slot. Order: lowest priority
+        first, then youngest (largest rid) — the oldest work at each
+        priority is preserved longest. Only blocks whose last
+        reference is the victim's table count as freed (prefix-shared
+        blocks survive via their index entry)."""
+        cands = [
+            r for slot, r in self.active.items()
+            if r.priority < req.priority and slot not in prefilling
+            and r.tokens
+        ]
+        cands.sort(key=lambda r: (r.priority, -r.rid))
+        chosen, freed, slots_freed = [], 0, 0
+        for r in cands:
+            if freed >= short and (have_slot or slots_freed > 0):
+                break
+            freed += sum(
+                1 for b in self.tables[r.slot]
+                if self.allocator.ref_count(b) == 1
+            )
+            slots_freed += 1
+            chosen.append(r)
+        if freed < short or not (have_slot or slots_freed > 0):
+            return []
+        return chosen
+
+    def _preempt(self, req: Request) -> Preemption:
+        """Bookkeeping half of a preemption: snapshot the offloadable
+        blocks, free slot + block references, re-queue the victim at
+        the waiting FRONT (it resumes as soon as space frees). The
+        engine performs the actual host offload from the snapshot —
+        the device rows stay intact until the next pool write."""
+        slot = req.slot
+        table = self.tables.pop(slot)
+        self.tables_version += 1
+        # resident K/V covers prompt + all generated tokens except the
+        # last sampled one (its K/V lands on the next decode step)
+        cur_len = len(req.prompt) + len(req.tokens) - 1
+        rec = Preemption(
+            req=req, slot=slot,
+            blocks=tuple(table[: blocks_for(
+                cur_len, self.allocator.block_size
+            )]),
+            cur_len=cur_len,
+        )
+        self.active.pop(slot)
+        req.slot = None
+        self._free.append(slot)
+        self._free.sort()
+        self.allocator.deref(table)
+        self._preempted[req.rid] = rec
+        self.waiting.appendleft(req)
+        return rec
+
     def on_prefill_complete(self, req: Request) -> None:
         """Register the request's prompt rows as a reusable prefix (its
-        slot's first ``len(prompt)`` rows now hold that K/V)."""
-        if self.prefix_cache is not None and req.slot is not None:
+        slot's first ``len(prompt)`` rows now hold that K/V). Paged
+        mode indexes the prompt's FULL blocks by refcount instead."""
+        if req.slot is None:
+            return
+        if self.prefix_index is not None:
+            n_full = len(req.prompt) // self.allocator.block_size
+            if n_full:
+                self.prefix_index.insert(
+                    req.prompt, self.tables[req.slot][:n_full]
+                )
+        elif self.prefix_cache is not None:
             self.prefix_cache.insert(req.slot, req.prompt)
 
     def flush_prefix_cache(self) -> None:
         """Invalidate every cached prefix and return donor slots to the
-        free list (weight refresh: resident rows are stale)."""
+        free list (weight refresh: resident rows are stale). Paged mode
+        releases the index's block references instead — donors never
+        occupied slots there."""
+        if self.prefix_index is not None:
+            self.prefix_index.flush()
+            return
         if self.prefix_cache is None:
             return
         self._free.extend(self.prefix_cache.flush())
@@ -293,7 +524,19 @@ class Scheduler:
         RETAINED as a donor instead (evicted LRU under pressure)."""
         req = self.active.pop(slot)
         req.slot = None
-        if self.prefix_cache is not None and self.prefix_cache.release(slot):
+        if self.allocator is not None:
+            # paged: the slot ALWAYS frees (donors never occupy one);
+            # the table's block references drop, and any blocks the
+            # prefix index holds (inserted at prefill completion)
+            # survive on the index's own references
+            table = self.tables.pop(slot, None)
+            if table is not None:
+                self.allocator.deref(table)
+                self.tables_version += 1
+        elif (
+            self.prefix_cache is not None
+            and self.prefix_cache.release(slot)
+        ):
             return req  # resident donor — off the free list
         self._free.append(slot)
         self._free.sort()

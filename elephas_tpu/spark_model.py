@@ -1196,6 +1196,10 @@ class SparkModel:
         prefix_min_reuse: int = 1,
         prefill_chunk: int | None = None,
         prefill_budget: int | None = None,
+        paged: bool = False,
+        block_size: int | None = None,
+        num_blocks: int | None = None,
+        preemption: bool = False,
     ):
         """A continuous-batching :class:`~elephas_tpu.serving.engine.\
 InferenceEngine` over this wrapper's mesh — the serving analogue of
@@ -1214,6 +1218,14 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
         the batch axes and heads over the model axis). Every gang
         process must submit the identical request sequence (SPMD
         contract, as for :meth:`generate`).
+
+        ``paged=True`` (ISSUE 7) switches the KV storage to the paged
+        block-pool arena: per-request reservations of
+        ``ceil((prompt + max_new_tokens) / block_size)`` blocks out of
+        ``num_blocks`` (default: capacity parity with the fixed
+        arena), copy-free prefix sharing when ``prefix_cache=True``,
+        and — with ``preemption=True`` — priority-based preempt/
+        host-offload/resume under pool pressure.
         """
         from elephas_tpu.serving import InferenceEngine
 
@@ -1240,6 +1252,10 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
             prefix_min_reuse=prefix_min_reuse,
             prefill_chunk=prefill_chunk,
             prefill_budget=prefill_budget,
+            paged=paged,
+            block_size=block_size,
+            num_blocks=num_blocks,
+            preemption=preemption,
         )
 
     # -- persistence ---------------------------------------------------

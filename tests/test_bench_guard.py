@@ -256,6 +256,12 @@ class TestFaultPathLint:
         # this lint — pin it explicitly so a future rename cannot
         # silently drop it from the glob
         assert any(f.endswith("sharding.py") for f in files)
+        # ISSUE 7: the paged-arena modules (block allocator refcounts,
+        # block-table programs) corrupt KV silently if an error is
+        # eaten mid-admission — pin them the same way
+        assert any(f.endswith("paged_kv.py") for f in files)
+        assert any(f.endswith(os.path.join("serving", "blocks.py"))
+                   for f in files)
         return root, files
 
     def test_no_bare_or_swallowed_excepts_on_fault_paths(self):
@@ -310,6 +316,11 @@ class TestTelemetryWallClockLint:
                 ))
             )
         assert len(files) > 9
+        # ISSUE 7: the paged scheduler/allocator order a gang-
+        # replicated schedule — wall clock there forks SPMD processes
+        assert any(f.endswith("paged_kv.py") for f in files)
+        assert any(f.endswith(os.path.join("serving", "blocks.py"))
+                   for f in files)
         offences = []
         for path in files:
             with open(path) as f:
