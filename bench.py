@@ -805,6 +805,232 @@ def _serving_longctx_section(model, maxlen, vocab, num_slots_fixed=4,
     }
 
 
+_SPECDEC_CHILD = """
+import json, sys
+sys.path.insert(0, sys.argv[1])
+import bench
+print(json.dumps(bench._serving_specdec_section()))
+"""
+
+
+def _serving_specdec_subprocess():
+    """Run the specdec section in a SINGLE-DEVICE child process (the
+    ``_SCALING_CHILD`` pattern): the serving preset's parent process
+    carves the host CPU into 8 virtual XLA devices, which divides the
+    compute threads per device ~8x and drowns the per-dispatch floor
+    in artificially slow compute — a CPU-emulation artifact (real
+    deployments do not split one chip eight ways), and exactly the
+    regime distortion the section docstring explains away for the
+    deeper stand-in. The child sees one full-speed CPU device, where
+    dispatch overhead genuinely dominates the tiny stand-in's step —
+    the accelerator-decode analogue. A child gate failure (non-zero
+    exit) re-raises as ImplausibleTiming, so the preset still refuses
+    to emit JSON."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+        KERAS_BACKEND="jax", XLA_FLAGS="",
+    )
+    repo = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, "-c", _SPECDEC_CHILD, repo],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd=repo,
+    )
+    if proc.returncode != 0:
+        raise ImplausibleTiming(
+            f"specdec child failed: {proc.stderr[-800:]}"
+        )
+    lines = [
+        l for l in proc.stdout.splitlines() if l.startswith("{")
+    ]
+    if len(lines) != 1:
+        raise ImplausibleTiming(
+            f"specdec child emitted no JSON record: "
+            f"{proc.stdout[-400:]!r}"
+        )
+    return json.loads(lines[-1])
+
+
+def _serving_specdec_section(rounds=5, spec_k=4, num_slots=8):
+    """Speculative decoding (ISSUE 8): decode-only tok/s with
+    draft-and-verify ON vs OFF, alternating rounds, greedy. The
+    headline figure is decode-only tok/s (TTFT excluded, from the
+    engines' own ``token_times`` counters — ISSUE 8 satellite), the
+    number speculation actually moves; aggregate tok/s would bury it
+    under admission effects.
+
+    **Model choice: the dispatch-bound d64L2 stand-in, TRAINED.**
+    Speculation's win is fixed-cost amortization: on real
+    accelerators every decode step streams the full weights and pays
+    a launch, so verifying K+1 tokens costs barely more than one —
+    the per-STEP overhead is the lever. The CPU analogue of that
+    overhead regime is the small dispatch-bound model, where program
+    launch + host loop dominate the per-step cost. The deeper d128L4
+    stand-in the latency sections use is the OPPOSITE regime here —
+    on CPU its verify compute scales ~linearly with the window, so
+    with acceptance a and window W the ceiling is (a·K+1)/W ≈ 1.0x BY
+    CONSTRUCTION (measured: 0.86x at 89% acceptance) — a claim about
+    a regime no accelerator decode loop is in. And the stand-in must
+    be TRAINED (periodic sequences, greedy-exact continuations): an
+    untrained model's argmax is noise no drafter could predict, and
+    acceptance would measure nothing.
+
+    Two measurements, both GATED (the preset refuses JSON on
+    failure):
+
+    - **lookup-friendly** (periodic prompts the n-gram drafter
+      predicts and the trained model keeps emitting): GATE >= 1.3x
+      decode-only tok/s, with the measured acceptance rate reported
+      and sanity-floored at 0.5 — below that the workload failed to
+      be lookup-friendly and the speedup claim is vacuous.
+    - **adversarial drafts** (same workload, a drafter whose guesses
+      NEVER land — the limiting case of lookup-hostility; a merely
+      random PROMPT cannot collapse acceptance here, because this
+      model's generated tail is itself repetitive and thus
+      lookup-predictable): the per-request acceptance throttle must
+      fire and fall back to plain decode. GATE: >= 0.7x of the
+      spec-off engine (the bounded probe tax), throttle counter > 0 —
+      otherwise the fallback story is untested fiction.
+    """
+    import numpy as np
+
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import transformer_lm
+    from elephas_tpu.serving import Drafter, InferenceEngine
+
+    class AdversarialDrafter(Drafter):
+        """Always-wrong drafts: a token the trained stand-in never
+        emits — the limiting case of lookup-hostility (acceptance
+        exactly 0), load-testing the throttle's worst-case bound."""
+
+        def __init__(self, bad_token: int):
+            self.bad = int(bad_token)
+
+        def propose(self, req, k):
+            return [self.bad] * int(k)
+
+    maxlen, vocab = 64, 16
+    model = transformer_lm(
+        vocab_size=vocab, maxlen=maxlen, d_model=64, num_heads=2,
+        num_layers=2, dropout=0.0, lr=1e-2, seed=0,
+    )
+    rng = np.random.default_rng(29)
+    starts = rng.integers(2, 6, size=512)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+    log.info("specdec: training the d64L2 stand-in (periodic data)")
+    SparkModel(model, num_workers=4).fit(
+        (x, y), epochs=10, batch_size=32
+    )
+
+    # workload sized so even the SPECULATIVE engine's round stays
+    # above the credibility floor on a fast unloaded box (~0.04s was
+    # observed for 12 requests)
+    n_req, budget, p_len = 32, 48, 16
+    friendly = [
+        (((int(rng.integers(2, 6)) + np.arange(p_len)) % 4 + 2)
+         .astype(np.int32), budget)
+        for _ in range(n_req)
+    ]
+    engines = {
+        "off": InferenceEngine(model, num_slots=num_slots),
+        "on": InferenceEngine(
+            model, num_slots=num_slots, speculative=True,
+            spec_k=spec_k,
+        ),
+        # token 1 is outside the training alphabet {2..5}: the trained
+        # model never emits it greedily, so acceptance is exactly 0
+        "adversarial": InferenceEngine(
+            model, num_slots=num_slots, speculative=True,
+            spec_k=spec_k, spec_drafter=AdversarialDrafter(1),
+        ),
+    }
+    for eng in engines.values():  # compile warmup: verify, decode,
+        eng.run(friendly)         # fallback window, every bucket
+        eng.run(friendly)
+
+    def decode_tps(reqs):
+        toks = sum(
+            len(r.token_times) - 1
+            for r in reqs if len(r.token_times) > 1
+        )
+        secs = sum(
+            r.token_times[-1] - r.token_times[0]
+            for r in reqs if len(r.token_times) > 1
+        )
+        return toks / secs
+
+    tps = {label: [] for label in engines}
+    s0 = {label: eng.stats() for label, eng in engines.items()}
+    for _r in range(rounds):
+        for label, eng in engines.items():  # alternating rounds
+            reqs = [eng.submit(p, mn) for p, mn in friendly]
+            t0 = time.perf_counter()
+            eng.run()
+            dt = time.perf_counter() - t0
+            if dt <= MIN_CREDIBLE_DT:
+                raise ImplausibleTiming(
+                    f"specdec round {dt:.4f}s below the "
+                    f"{MIN_CREDIBLE_DT}s credibility floor"
+                )
+            tps[label].append(decode_tps(reqs))
+    med = {k: sorted(v)[(len(v) - 1) // 2] for k, v in tps.items()}
+
+    def delta(label, key):
+        return engines[label].stats()[key] - s0[label][key]
+
+    drafted = delta("on", "spec_draft_tokens")
+    accepted = delta("on", "spec_accepted_tokens")
+    acceptance = accepted / drafted if drafted else 0.0
+    speedup = med["on"] / med["off"]
+    if speedup < 1.3:
+        raise ImplausibleTiming(
+            f"specdec gate: {med['on']:.1f} decode tok/s speculative "
+            f"vs {med['off']:.1f} plain ({speedup:.2f}x) under the "
+            f"1.3x floor on the lookup-friendly workload — "
+            f"speculation is not buying per-token speed"
+        )
+    if acceptance < 0.5:
+        raise ImplausibleTiming(
+            f"specdec gate: acceptance rate {acceptance:.2f} below "
+            f"0.5 on the lookup-friendly workload — the speedup "
+            f"measured the wrong regime"
+        )
+    adv_ratio = med["adversarial"] / med["off"]
+    adv_throttled = delta("adversarial", "spec_throttled")
+    if adv_ratio < 0.7:
+        raise ImplausibleTiming(
+            f"specdec gate: adversarial-draft ratio {adv_ratio:.2f}x "
+            f"under the 0.7x floor — the acceptance throttle is not "
+            f"bounding the speculation tax"
+        )
+    if adv_throttled < 1:
+        raise ImplausibleTiming(
+            "specdec gate: adversarial drafts never tripped the "
+            "acceptance throttle — the fallback path went unexercised"
+        )
+    compiles = engines["on"].compile_stats()
+    return {
+        "spec_k": spec_k,
+        "requests": n_req,
+        "budget": budget,
+        "decode_tok_s_on": round(med["on"], 1),
+        "decode_tok_s_off": round(med["off"], 1),
+        "decode_speedup": round(speedup, 2),
+        "rounds_on": [round(v, 1) for v in tps["on"]],
+        "rounds_off": [round(v, 1) for v in tps["off"]],
+        "acceptance_rate": round(acceptance, 3),
+        "adversarial_decode_tok_s": round(med["adversarial"], 1),
+        "adversarial_ratio": round(adv_ratio, 2),
+        "adversarial_throttled": adv_throttled,
+        "verify_compiles": compiles["verify_compiles"],
+        "decode_compiles": compiles["decode_compiles"],
+    }
+
+
 def _serving_telemetry_section(model, maxlen, vocab, num_slots,
                                rounds=5):
     """Telemetry-overhead check (ISSUE 5 satellite): the same workload
@@ -1050,6 +1276,26 @@ def measure_serving(n_requests: int, num_slots: int, backend: str,
     # as the other latency sections — the TTFT half compares prefill
     # work, and the concurrency half is model-independent bookkeeping
     longctx = _serving_longctx_section(lat_model, maxlen, lat_vocab)
+    # speculative decoding (ISSUE 8): the section trains its OWN
+    # dispatch-bound stand-in on periodic data — predictable
+    # continuations are the regime prompt-lookup drafting exists for
+    # (the untrained stand-ins above would measure drafting against
+    # argmax noise), and per-dispatch overhead is the cost speculation
+    # amortizes (see the section docstring for why the deeper
+    # compute-bound stand-in would cap the win at ~1x by construction).
+    # Runs in a single-device subprocess: this parent's 8-way virtual
+    # CPU split starves per-device compute threads, a distortion of
+    # the very regime under measurement (_serving_specdec_subprocess).
+    specdec = _serving_specdec_subprocess()
+    log.info(
+        "serving specdec (draft-and-verify, trained d64L2 stand-in): "
+        "decode-only %.1f tok/s speculative vs %.1f plain (%.2fx, "
+        ">=1.3x required) at %.0f%% acceptance; adversarial drafts "
+        "%.2fx (>=0.7x required, throttle fired %dx)",
+        specdec["decode_tok_s_on"], specdec["decode_tok_s_off"],
+        specdec["decode_speedup"], specdec["acceptance_rate"] * 100,
+        specdec["adversarial_ratio"], specdec["adversarial_throttled"],
+    )
     log.info(
         "serving longctx (paged vs fixed, equal KV bytes): admitted "
         "concurrency %d vs %d (%.2fx, >=1.5x required), prefix-hit "
@@ -1116,10 +1362,15 @@ def measure_serving(n_requests: int, num_slots: int, backend: str,
         "itl_p99_ms": round(
             (eng_stats["inter_token_s"]["p99"] or 0.0) * 1e3, 3
         ),
+        # decode-only tok/s of the headline engine (ISSUE 8 satellite:
+        # TTFT excluded, straight from stats()'s token_times math) —
+        # per-token speed separated from batching/admission effects
+        "decode_tok_s": round(eng_stats["decode_tok_s"] or 0.0, 2),
         "prefix": prefix,
         "interference": interference,
         "telemetry": telemetry_overhead,
         "longctx": longctx,
+        "specdec": specdec,
     }
 
 

@@ -28,7 +28,15 @@ blocks` — the paged arena (ISSUE 7, ``serve(paged=True)``): a global
   its OWN worst case, prompt-prefix blocks share copy-free by refcount
   (:class:`~elephas_tpu.serving.prefix_cache.PagedPrefixIndex`), and
   low-priority requests can be preempted — K/V swapped to host — and
-  later resumed bit-exact.
+  later resumed bit-exact;
+- :mod:`elephas_tpu.serving.speculative` — draft-and-verify
+  speculative decoding (ISSUE 8, ``serve(speculative=True)``): an
+  n-gram prompt-lookup drafter or a small draft model proposes up to
+  ``spec_k`` tokens per slot, ONE batched verify forward scores them
+  over either arena, and the longest greedy-matching prefix (plus a
+  bonus token) lands per round — several tokens per target forward,
+  temperature-0 output bit-exact, with a per-request acceptance
+  throttle so hostile text falls back to plain decode.
 """
 
 from elephas_tpu.serving.blocks import BlockAllocator  # noqa: F401
@@ -50,4 +58,10 @@ from elephas_tpu.serving.paged_kv import (  # noqa: F401
     PagedKVPool,
     blocks_for,
     table_buckets,
+)
+from elephas_tpu.serving.speculative import (  # noqa: F401
+    AcceptanceThrottle,
+    DraftModelDrafter,
+    Drafter,
+    NgramDrafter,
 )

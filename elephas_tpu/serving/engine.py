@@ -47,6 +47,7 @@ from elephas_tpu.serving.kv_cache import (
     prefill_forward,
     prefix_copy,
     token_decode_step,
+    verify_forward,
 )
 from elephas_tpu.serving.paged_kv import (
     PagedKVPool,
@@ -54,9 +55,14 @@ from elephas_tpu.serving.paged_kv import (
     gather_blocks,
     paged_chunk_forward,
     paged_token_decode_step,
+    paged_verify_forward,
     scatter_blocks,
     table_bucket_for,
     table_buckets,
+)
+from elephas_tpu.serving.speculative import (
+    AcceptanceThrottle,
+    resolve_drafter,
 )
 from elephas_tpu.serving.scheduler import (
     Admission,
@@ -138,6 +144,19 @@ class InferenceEngine:
     stay a closed set: one decode program per block-table bucket,
     one chunk program per (width, table bucket).
 
+    ``speculative=True`` (ISSUE 8) decodes draft-and-verify: a cheap
+    drafter (``spec_drafter``: ``"ngram"`` prompt-lookup by default, or
+    a small draft model / custom :class:`~elephas_tpu.serving.\
+speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
+    batched verify forward scores them all, accepting the longest
+    greedy-matching prefix plus a bonus token — several tokens per
+    target forward, bit-exact at temperature 0 (temp>0 streams diverge
+    from plain decode like chunked prefill: deterministic per config,
+    differently keyed). A per-request acceptance throttle falls back to
+    plain decode when drafts stop landing and re-probes periodically.
+    Works on both arenas; one verify program per window width (fixed)
+    or (width, table bucket) pair (paged) keeps the shape set closed.
+
     PP ring decode is not integrated yet — construct via
     ``SparkModel.serve()`` on a DP/TP mesh, or directly on no mesh.
     """
@@ -153,7 +172,10 @@ class InferenceEngine:
                  paged: bool = False,
                  block_size: int | None = None,
                  num_blocks: int | None = None,
-                 preemption: bool = False):
+                 preemption: bool = False,
+                 speculative: bool = False,
+                 spec_k: int | None = None,
+                 spec_drafter=None):
         import jax
         import jax.numpy as jnp
 
@@ -278,6 +300,28 @@ class InferenceEngine:
             self._tbuckets = table_buckets(self.max_blocks_per_slot)
         self.preemption = bool(preemption)
 
+        # -- speculative decoding knobs (ISSUE 8) ----------------------
+        self.speculative = bool(speculative)
+        if not self.speculative:
+            if spec_k is not None or spec_drafter is not None:
+                raise ValueError(
+                    "spec_k/spec_drafter require speculative=True — "
+                    "silently ignoring the knobs would misreport how "
+                    "the engine decodes"
+                )
+            self.spec_k = None
+        else:
+            k = 4 if spec_k is None else int(spec_k)
+            # the verify window feeds 1 (last token) + k drafts; its
+            # widest write lands at position cursor + k, capped by the
+            # per-slot draft budget at maxlen - 1 — k itself only needs
+            # to leave room for at least one real position
+            if not 1 <= k < self.maxlen:
+                raise ValueError(
+                    f"spec_k={k} outside [1, maxlen={self.maxlen})"
+                )
+            self.spec_k = k
+
         if self.paged:
             self.arena = PagedKVPool(
                 flash_layers, self.num_blocks, self.block_size,
@@ -398,6 +442,27 @@ class InferenceEngine:
             "elephas_serving_rejected_total",
             "Requests rejected at submit because prompt + "
             "max_new_tokens can never fit the block pool",
+        )
+        # speculative decoding (ISSUE 8): counters exist in BOTH modes
+        # (keys in stats() never vary by config); a non-speculative
+        # engine simply never increments them
+        self._m_spec_drafted = _c(
+            "elephas_serving_spec_draft_tokens_total",
+            "Drafted tokens scored by the speculative verify forward",
+        )
+        self._m_spec_accepted = _c(
+            "elephas_serving_spec_accepted_tokens_total",
+            "Drafted tokens accepted by the longest-matching-prefix "
+            "rule (each saved one target-model decode step)",
+        )
+        self._m_spec_rounds = _c(
+            "elephas_serving_spec_verify_rounds_total",
+            "Batched speculative verify dispatches",
+        )
+        self._m_spec_throttled = _c(
+            "elephas_serving_spec_throttled_total",
+            "Times a request's collapsed acceptance rate tripped the "
+            "drafting throttle (fell back to plain decode)",
         )
         treg.gauge(
             "elephas_serving_slots", "KV-cache slots in the arena",
@@ -640,6 +705,59 @@ class InferenceEngine:
                 _vec(jnp.where(mask, r_temps, temps)),
             )
 
+        # -- speculative verify (ISSUE 8): ONE batched forward scores a
+        # whole draft window for every verifying slot — row j of the
+        # [num_slots, K+1] sample matrix is the model's own token for
+        # position offs+j+1, which the host compares against the drafts
+        # (accept the longest matching prefix + one bonus token). The
+        # window width is STATIC (spec_k + 1); per-slot shorter drafts
+        # ride the same program via the n_fed mask — one verify compile
+        # total on the fixed arena, one per table bucket paged. One key
+        # split per round covers all window positions (temp>0 streams
+        # therefore diverge from plain decode, like chunked prefill;
+        # temp-0 rows are argmax and key-free).
+        # The round's host-built vectors ride as ONE packed [num_slots,
+        # W+3] int32 upload (tokens | offset | n_fed | active) — four
+        # separate stage calls measurably taxed the round on
+        # dispatch-bound backends, where per-transfer overhead rivals
+        # the dispatch itself.
+        W_spec = (self.spec_k + 1) if self.speculative else 0
+
+        def _unpack_verify(packed):
+            tokens = packed[:, :W_spec]
+            offs = packed[:, W_spec]
+            n_fed = packed[:, W_spec + 1]
+            act = packed[:, W_spec + 2] != 0
+            return tokens, offs, n_fed, act
+
+        def _sample_window(logits, temps, key):
+            B, C, V = logits.shape
+            key, sub = jax.random.split(key)
+            sampled = _sample_dynamic(
+                logits.reshape(B * C, V), sub,
+                jnp.repeat(temps, C), self.top_k, self.top_p,
+            ).reshape(B, C)
+            return key, sampled
+
+        def spec_verify(w, caches, packed, temps, key):
+            tokens, offs, n_fed, act = _unpack_verify(packed)
+            logits, caches = verify_forward(
+                model, w, tokens, caches, offs, n_fed, act, maxlen
+            )
+            caches = _constrain_all(caches)
+            key, sampled = _sample_window(logits, temps, key)
+            return caches, key, sampled
+
+        def paged_spec_verify(w, caches, tables, packed, temps, key):
+            tokens, offs, n_fed, act = _unpack_verify(packed)
+            logits, caches = paged_verify_forward(
+                model, w, tokens, caches, tables, offs, n_fed, act,
+                self.block_size, maxlen, local=mesh is None,
+            )
+            caches = _constrain_all(caches)
+            key, sampled = _sample_window(logits, temps, key)
+            return caches, key, sampled
+
         # the fixed program set: ONE decode window + one prefill per
         # prompt bucket (p_lens/admit/new_temps ride as traced vectors,
         # so only the bucket SHAPE triggers a compile), plus ONE prefix
@@ -666,6 +784,10 @@ class InferenceEngine:
             self._resume_state_jit = jax.jit(
                 resume_state, donate_argnums=(0, 1, 2)
             )
+            self._verify_jit = (
+                jax.jit(paged_spec_verify, donate_argnums=(1, 5))
+                if self.speculative else None
+            )  # args: w, caches, tables, packed, temps, key
         else:
             self._prefill_jit = jax.jit(
                 prefill, donate_argnums=(1, 2, 3, 4, 9)
@@ -681,6 +803,10 @@ class InferenceEngine:
             #         clens, act, fin, p_lens, new_temps, src_idx,
             #         copy_mask, copy_len, key, has_copy (static)
             self._copy_jit = jax.jit(copy_prefix, donate_argnums=(0,))
+            self._verify_jit = (
+                jax.jit(spec_verify, donate_argnums=(1, 4))
+                if self.speculative else None
+            )  # args: w, caches, packed, temps, key
 
         self.refresh_weights()
         self._caches, self._lengths, self._last, self._temps = (
@@ -700,6 +826,22 @@ class InferenceEngine:
         # host store of offloaded (preempted) requests' K/V
         self._tables_cache: tuple | None = None
         self._offloaded: dict[int, _OffloadRecord] = {}
+        # speculative host state (ISSUE 8): the drafter, the per-request
+        # acceptance throttle, and the device-state dirty flag — verify
+        # rounds track positions from HOST truth (resident length =
+        # prompt + generated - 1), leaving the device length/last
+        # vectors stale; the flag triggers a re-stage before any plain
+        # decode window reads them (the all-throttled fallback path)
+        self._drafter = (
+            resolve_drafter(
+                spec_drafter, num_slots=self.num_slots,
+                maxlen=self.maxlen, vocab=self.vocab,
+            ) if self.speculative else None
+        )
+        self._spec_throttle = (
+            AcceptanceThrottle() if self.speculative else None
+        )
+        self._spec_dirty = False
 
     # -- device staging ------------------------------------------------
 
@@ -745,6 +887,14 @@ class InferenceEngine:
             # re-register as donors, or the stale-splice the flush
             # prevents comes back through the side door
             self._stale_prefill = set(self._prefilling)
+        # a draft-model drafter re-uploads ITS model's weights and
+        # drops its committed frontiers (full re-ingest): the draft
+        # model may have been retrained alongside the target — stale
+        # draft weights would silently collapse acceptance and turn
+        # speculation off through the throttle with no signal
+        drafter = getattr(self, "_drafter", None)
+        if drafter is not None:
+            drafter.refresh_weights()
 
         if self.mesh is None:
             self._weights = {
@@ -801,6 +951,19 @@ class InferenceEngine:
         # never pads to a prompt bucket, so the ladder doesn't bound it.
         if not self.prefill_chunk:
             self.scheduler.bucket_for(p)
+        if priority and not self.preemption:
+            # ISSUE 8 satellite (knob-validation parity with the paged
+            # knobs): only the preemption path ever consults priority —
+            # a caller passing it on any other engine is expressing an
+            # expectation this engine cannot honor, and silence here
+            # would let them believe their high-priority traffic jumps
+            # the queue. Warn (not raise): the request itself is valid.
+            logger.warning(
+                "submit(priority=%d) on an engine without "
+                "preemption=True — priority is recorded but IGNORED "
+                "(admission stays FIFO); serve with paged=True, "
+                "preemption=True for priority scheduling", priority,
+            )
         req = self.scheduler.make_request(
             prompt, max_new_tokens, temperature=temperature, eos_id=eos_id,
             on_token=on_token, priority=priority,
@@ -865,6 +1028,8 @@ class InferenceEngine:
             self.scheduler.reclaim(slot)
             self._set_active(slot, False)
             self._m_finished.inc()
+            if self._spec_throttle is not None:
+                self._spec_throttle.forget(req.rid)
             self.finished[req.rid] = req
             self._evict_finished()
         return done
@@ -1319,6 +1484,19 @@ class InferenceEngine:
         ):
             return emitted
         self._m_decode_windows.inc()
+        if self.speculative:
+            emitted.extend(self._spec_decode_phase())
+        else:
+            emitted.extend(self._decode_window())
+        return emitted
+
+    def _decode_window(self):
+        """One arena-wide plain decode window of ``steps_per_sync``
+        steps — the non-speculative decode phase, and the speculative
+        engine's fallback when no slot drafted this round."""
+        if self.speculative and self._spec_dirty:
+            self._refresh_decode_state()
+        emitted: list[tuple[Request, int, bool]] = []
         with self._tracer.span(
             "serve.decode_window", steps=self.steps_per_sync,
             active=len(self.scheduler.active),
@@ -1347,6 +1525,144 @@ class InferenceEngine:
                         continue  # mid-prefill: no decode tokens yet
                     done = self._emit(req, int(toks[i, slot]))
                     emitted.append((req, req.tokens[-1], done))
+        return emitted
+
+    # -- speculative decoding (ISSUE 8) --------------------------------
+
+    def _refresh_decode_state(self):
+        """Re-stage the device length/last vectors from host truth.
+        Verify rounds advance positions host-side only (resident length
+        = prompt + generated - 1, the invariant preemption's ``cur_len``
+        already relies on), so before a plain decode window reads the
+        device vectors they must be rebuilt. Mid-prefill and idle slots
+        stage zeros — the decode active mask excludes them, and a later
+        chunk finalize sets their real state on device."""
+        lengths = np.zeros((self.num_slots,), np.int32)
+        last = np.zeros((self.num_slots,), np.int32)
+        for slot, req in self.scheduler.active.items():
+            if slot in self._prefilling or not req.tokens:
+                continue
+            lengths[slot] = len(req.prompt) + len(req.tokens) - 1
+            last[slot] = req.tokens[-1]
+        self._lengths = self._stage_slots(lengths)
+        self._last = self._stage_slots(last)
+        self._spec_dirty = False
+
+    def _spec_decode_phase(self):
+        """One speculative decode round: collect drafts for every
+        decoding slot (throttle- and budget-capped), then either run
+        ONE batched verify forward over the whole window — emitting
+        the accepted prefix + bonus token per slot — or, when nobody
+        drafted (throttled, no n-gram match, budget exhausted), fall
+        back to one plain ``steps_per_sync`` decode window so
+        speculation-hostile phases keep the multi-step amortization."""
+        items = []
+        for slot in sorted(self.scheduler.active):
+            if slot in self._prefilling:
+                continue
+            req = self.scheduler.active[slot]
+            remaining = req.max_new_tokens - len(req.tokens)
+            cursor = len(req.prompt) + len(req.tokens) - 1
+            # the verify window feeds 1 + n_drafts tokens at positions
+            # cursor.. and emits at most n_drafts + 1 tokens: drafts
+            # are capped so writes stay inside the slot's row (and its
+            # paged block reservation) and emissions inside the budget
+            k_cap = min(
+                self.spec_k, remaining - 1, self.maxlen - 1 - cursor
+            )
+            if k_cap >= 1 and self._spec_throttle.should_draft(req.rid):
+                items.append((slot, req, k_cap))
+        proposals = (
+            self._drafter.propose_batch(items) if items else {}
+        )
+        # defend the extension point: a custom drafter returning MORE
+        # than its k (which sizes the packed window and the accept
+        # loop) or drafts for slots it was never asked about (which
+        # would bypass the throttle and the budget/maxlen caps) must
+        # not corrupt the round — clip to each item's own cap, drop
+        # uninvited slots
+        caps = {slot: k for slot, _req, k in items}
+        proposals = {
+            slot: list(d)[: caps[slot]]
+            for slot, d in proposals.items()
+            if slot in caps and d
+        }
+        drafted = sum(len(d) for d in proposals.values())
+        if drafted == 0:
+            return self._decode_window()
+        return self._verify_round(proposals, drafted)
+
+    def _verify_round(self, proposals, drafted: int):
+        """Dispatch one batched verify forward and commit its verdict:
+        per slot, accept the longest draft prefix matching the model's
+        own sampled tokens, emit those plus the bonus token, and roll
+        the resident length back over the rejected tail (host-side
+        cursor arithmetic — the garbage K/V is rewritten before any
+        query can see it; paged tails stay inside already-reserved
+        blocks, so the allocator is never touched mid-step)."""
+        W = self.spec_k + 1
+        # one packed [num_slots, W+3] upload: tokens | offset | n_fed
+        # | active — see the program definition for why
+        packed = np.zeros((self.num_slots, W + 3), np.int32)
+        verifying = []
+        for slot in sorted(self.scheduler.active):
+            if slot in self._prefilling:
+                continue
+            req = self.scheduler.active[slot]
+            drafts = proposals.get(slot, [])
+            packed[slot, 0] = req.tokens[-1]
+            packed[slot, 1:1 + len(drafts)] = drafts
+            packed[slot, W] = len(req.prompt) + len(req.tokens) - 1
+            packed[slot, W + 1] = 1 + len(drafts)
+            packed[slot, W + 2] = 1
+            verifying.append((slot, req, drafts))
+        emitted: list[tuple[Request, int, bool]] = []
+        with self._tracer.span(
+            "serve.verify", slots=len(verifying), drafted=drafted,
+            k=self.spec_k,
+        ) as span:
+            if self.paged:
+                self._caches, self._key, sampled = self._verify_jit(
+                    self._weights, self._caches, self._staged_tables(),
+                    self._stage_slots(packed), self._temps, self._key,
+                )
+            else:
+                self._caches, self._key, sampled = self._verify_jit(
+                    self._weights, self._caches,
+                    self._stage_slots(packed), self._temps, self._key,
+                )
+            toks = self._host(sampled)  # [num_slots, W]
+            self.scheduler.note_step()
+            accepted_total = 0
+            for slot, req, drafts in verifying:
+                t = toks[slot]
+                a = 0
+                while a < len(drafts) and drafts[a] == int(t[a]):
+                    a += 1
+                # accepted drafts + the model's bonus token, in order;
+                # a mid-window EOS finish discards the rest
+                n_emitted = 0
+                for j in range(a + 1):
+                    done = self._emit(req, int(t[j]))
+                    emitted.append((req, req.tokens[-1], done))
+                    n_emitted += 1
+                    if done:
+                        break
+                # count only accepted drafts that actually EMITTED —
+                # an EOS inside the window discards the matched tail,
+                # and those drafts saved no decode step (the counter's
+                # promise); the throttle gets the same truthful figure
+                a = min(a, n_emitted)
+                accepted_total += a
+                req.spec_drafted += len(drafts)
+                req.spec_accepted += a
+                if self._spec_throttle.note(req.rid, len(drafts), a):
+                    self._m_spec_throttled.inc()
+            span.set(accepted=accepted_total)
+        self._m_spec_drafted.inc(drafted)
+        self._m_spec_accepted.inc(accepted_total)
+        self._m_spec_rounds.inc()
+        self._spec_dirty = True
         return emitted
 
     def stream(self):
@@ -1451,19 +1767,27 @@ class InferenceEngine:
                 "copy_compiles": 0,  # prefix hits are table splices
                 "offload_compiles": n(self._gather_jit),
                 "resume_compiles": n(self._scatter_jit),
+                "verify_compiles": (
+                    n(self._verify_jit) if self.speculative else 0
+                ),
                 "buckets": tuple(self.scheduler.buckets),
                 "table_buckets": tuple(self._tbuckets),
                 "prefill_chunk": self.prefill_chunk,
                 "block_size": self.block_size,
                 "num_blocks": self.num_blocks,
+                "spec_k": self.spec_k,
             }
         return {
             "decode_compiles": n(self._decode_jit),
             "prefill_compiles": n(self._prefill_jit),
             "chunk_prefill_compiles": n(self._chunk_jit),
             "copy_compiles": n(self._copy_jit),
+            "verify_compiles": (
+                n(self._verify_jit) if self.speculative else 0
+            ),
             "buckets": tuple(self.scheduler.buckets),
             "prefill_chunk": self.prefill_chunk,
+            "spec_k": self.spec_k,
         }
 
     @staticmethod
@@ -1492,6 +1816,21 @@ class InferenceEngine:
         ]
         ttfts = [r.ttft for r in finished if r.ttft is not None]
         itls = [d for r in finished for d in r.inter_token_times]
+        # decode-only tok/s (ISSUE 8 satellite): per-token speed with
+        # TTFT excluded — from each finished request's first-to-last
+        # token arrival window, the same token_times the percentiles
+        # already read. This is the figure speculation moves; aggregate
+        # tok/s confounds it with batching and admission effects.
+        d_toks = sum(
+            len(r.token_times) - 1
+            for r in finished if len(r.token_times) > 1
+        )
+        d_secs = sum(
+            r.token_times[-1] - r.token_times[0]
+            for r in finished if len(r.token_times) > 1
+        )
+        drafted = int(self._m_spec_drafted.value)
+        accepted = int(self._m_spec_accepted.value)
         out = {
             "total_generated": self.total_generated,
             "decode_steps": self.scheduler._steps,
@@ -1508,6 +1847,17 @@ class InferenceEngine:
             "preemptions": int(self._m_preemptions.value),
             "resumes": int(self._m_resumes.value),
             "rejected": int(self._m_rejected.value),
+            "decode_tok_s": (d_toks / d_secs) if d_secs > 0 else None,
+            # speculative decoding (ISSUE 8): registry-backed like the
+            # paged counters — stats() and a /metrics scrape read the
+            # SAME series; the acceptance rate is derived at read time
+            "spec_draft_tokens": drafted,
+            "spec_accepted_tokens": accepted,
+            "spec_acceptance_rate": (
+                accepted / drafted if drafted else None
+            ),
+            "spec_verify_rounds": int(self._m_spec_rounds.value),
+            "spec_throttled": int(self._m_spec_throttled.value),
         }
         if self.paged:
             alloc = self.scheduler.allocator

@@ -539,6 +539,37 @@ def chunked_prefill_forward(model, w, tokens_chunk, caches, offsets,
     }
 
 
+def verify_forward(model, w, tokens_window, caches, offsets, n_fed,
+                   active, maxlen):
+    """Batched K-token speculative VERIFY over the slot arena (ISSUE
+    8): slot ``b`` feeds ``n_fed[b]`` tokens — its last sampled token
+    followed by up to ``K-1`` drafted guesses — at absolute positions
+    ``offsets[b] .. offsets[b]+n_fed[b]-1``, writes their K/V into its
+    row, attends causally over the updated row, and returns a logits
+    row per window position: row ``j`` scores the token at position
+    ``offsets[b]+j+1``. The engine samples every row in one shot and
+    accepts the longest draft prefix matching the model's own tokens
+    plus one bonus token — at temperature 0 that prefix is BY
+    CONSTRUCTION what sequential decode would have produced, so
+    speculation never changes greedy output.
+
+    This IS the chunked-prefill program with generated tokens in place
+    of prompt tokens: chunk writes land first, queries attend over the
+    updated arena row masked to ``position <= query position``, and a
+    masked tail (``n_fed[b] < K``) neither writes nor matters — the
+    delegation below is the whole point (one attention variant to keep
+    bit-exact, one compiled shape per window width ``K``). The
+    CURSOR-ROLLBACK contract lives host-side: rejected positions
+    ``offsets[b]+a+1 ..`` hold garbage K/V after the call, and the
+    engine simply rolls the slot's resident length back to
+    ``offsets[b]+a+1`` — every garbage row is rewritten by a later
+    feed before any query can see it (the same rewrite-before-visible
+    invariant prefill padding already relies on)."""
+    return chunked_prefill_forward(
+        model, w, tokens_window, caches, offsets, n_fed, active, maxlen
+    )
+
+
 def prefix_copy(caches, src_idx, copy_mask, copy_len, maxlen):
     """Slot-to-slot prefix transplant (ISSUE 4): destination slot ``d``
     (where ``copy_mask[d]``) receives donor slot ``src_idx[d]``'s first

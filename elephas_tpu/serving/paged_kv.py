@@ -66,6 +66,7 @@ __all__ = [
     "table_bucket_for",
     "paged_token_decode_step",
     "paged_chunk_forward",
+    "paged_verify_forward",
     "gather_blocks",
     "scatter_blocks",
 ]
@@ -484,6 +485,30 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
     return logits, {
         name: ctx_new.get(name, pool[name]) for name in pool
     }
+
+
+def paged_verify_forward(model, w, tokens_window, pool, tables,
+                         offsets, n_fed, active, block_size, maxlen,
+                         local=False):
+    """Batched K-token speculative verify over the PAGED arena (ISSUE
+    8) — the block-table analogue of :func:`~elephas_tpu.serving.\
+kv_cache.verify_forward`: slot ``b`` feeds its last sampled token plus
+    drafted guesses at positions ``offsets[b] ..``, K/V lands in the
+    slot's table blocks, and a logits row comes back per window
+    position for the engine's accept-longest-matching-prefix rule.
+
+    Delegates to :func:`paged_chunk_forward` (generated tokens instead
+    of prompt tokens; same writes-land-first causal attention), so
+    there is exactly one verify program per (window width ``K``,
+    table bucket) pair — both from closed ladders. Rollback is free:
+    a rejected tail's garbage rows live INSIDE blocks the request
+    already reserved up front (``ceil((prompt + max_new) / bs)``),
+    so rolling the cursor back never touches the allocator, and the
+    rows are rewritten before any query can see them."""
+    return paged_chunk_forward(
+        model, w, tokens_window, pool, tables, offsets, n_fed, active,
+        block_size, maxlen, local=local,
+    )
 
 
 def gather_blocks(pool, ids):

@@ -93,6 +93,12 @@ class Request:
     token_times: list = field(default_factory=list)
     # prompt tokens served from the prefix cache instead of prefill
     reused_tokens: int = 0
+    # speculative decoding accounting (ISSUE 8): drafted tokens the
+    # engine's verify forward scored for THIS request, and how many it
+    # accepted — per-request views of the engine's registry counters
+    # (the acceptance throttle reads its own windowed state, not these)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def full_sequence(self) -> list:

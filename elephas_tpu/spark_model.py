@@ -1200,6 +1200,9 @@ class SparkModel:
         block_size: int | None = None,
         num_blocks: int | None = None,
         preemption: bool = False,
+        speculative: bool = False,
+        spec_k: int | None = None,
+        spec_drafter=None,
     ):
         """A continuous-batching :class:`~elephas_tpu.serving.engine.\
 InferenceEngine` over this wrapper's mesh — the serving analogue of
@@ -1226,6 +1229,14 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
         arena), copy-free prefix sharing when ``prefix_cache=True``,
         and — with ``preemption=True`` — priority-based preempt/
         host-offload/resume under pool pressure.
+
+        ``speculative=True`` (ISSUE 8) turns on draft-and-verify
+        decoding: ``spec_drafter`` (``"ngram"`` prompt-lookup by
+        default, or a small causal-LM keras model, or a custom
+        :class:`~elephas_tpu.serving.speculative.Drafter`) guesses up
+        to ``spec_k`` tokens per slot per round and one batched verify
+        forward accepts the longest greedy-matching prefix — multiple
+        tokens per target forward, temperature-0 output bit-exact.
         """
         from elephas_tpu.serving import InferenceEngine
 
@@ -1256,6 +1267,9 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
             block_size=block_size,
             num_blocks=num_blocks,
             preemption=preemption,
+            speculative=speculative,
+            spec_k=spec_k,
+            spec_drafter=spec_drafter,
         )
 
     # -- persistence ---------------------------------------------------

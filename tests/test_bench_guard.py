@@ -262,6 +262,13 @@ class TestFaultPathLint:
         assert any(f.endswith("paged_kv.py") for f in files)
         assert any(f.endswith(os.path.join("serving", "blocks.py"))
                    for f in files)
+        # ISSUE 8: the speculative drafter/throttle path rolls decode
+        # cursors back over rejected K/V — an eaten error there leaves
+        # a slot's resident-length bookkeeping silently wrong
+        assert any(
+            f.endswith(os.path.join("serving", "speculative.py"))
+            for f in files
+        )
         return root, files
 
     def test_no_bare_or_swallowed_excepts_on_fault_paths(self):
@@ -321,6 +328,12 @@ class TestTelemetryWallClockLint:
         assert any(f.endswith("paged_kv.py") for f in files)
         assert any(f.endswith(os.path.join("serving", "blocks.py"))
                    for f in files)
+        # ISSUE 8: drafting/throttling decisions replicate across the
+        # gang — wall clock in them would fork the schedule the same way
+        assert any(
+            f.endswith(os.path.join("serving", "speculative.py"))
+            for f in files
+        )
         offences = []
         for path in files:
             with open(path) as f:
