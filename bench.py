@@ -1125,6 +1125,290 @@ def _serving_telemetry_section(model, maxlen, vocab, num_slots,
     }
 
 
+def _serving_slo_section(model, maxlen, vocab, num_slots=4,
+                         n_hog=32, n_light=16, seed=23):
+    """Goodput under overload (ISSUE 10): FIFO vs fair-share + EDF +
+    admission control on an open-loop Poisson 2-tenant workload over
+    the d128L4 stand-in — one hog tenant bursting long prompts with
+    long budgets, one light tenant trickling short requests with tight
+    TTFT deadlines. Open-loop means arrivals NEVER wait for
+    completions (the overload regime closed-loop drivers hide).
+
+    Both runs drive the IDENTICAL arrival schedule (same seed, same
+    prompts, same deadlines — deadlines calibrated once from the
+    unloaded TTFT of a light request, so the bar does not move with
+    box speed). FIFO admits everything in arrival order; the policy
+    run serves tenants fair-share with deadline-EDF and sheds load
+    past a queue token-debt bound.
+
+    Three GATES (the preset refuses JSON on any miss):
+
+    1. **goodput** — requests meeting their TTFT deadline (a rejected
+       request counts as a miss) — policy >= 1.5x FIFO at the same
+       offered load;
+    2. **light-tenant p99 TTFT** (completed requests) — policy <=
+       0.5x its FIFO value: fairness must actually isolate the light
+       tenant from the hog, not just shuffle averages;
+    3. **zero starvation** — every request the policy run ADMITTED
+       finished (no admitted request lost to reordering/aging, the
+       aging bound's end-to-end proof).
+
+    A fourth refusal is an honesty cross-check, not a perf bar: the
+    bench's host-side deadline accounting must agree exactly with the
+    engine's registry-backed per-tenant SLO counters (one comparison
+    site in _emit, one here, same token_times — drift means a bug)."""
+    import numpy as np
+
+    from elephas_tpu.serving import (
+        FairSharePolicy,
+        InferenceEngine,
+        blocks_for,
+    )
+
+    rng = np.random.default_rng(seed)
+    block_size = 16
+    hog_p = min(64, maxlen // 2)
+    light_p, light_mn = 8, 8
+    # open-loop Poisson arrivals: the hog bursts long prompts with
+    # long (staggered — completions must not cohort) budgets at mean
+    # 10ms gaps, and the light tenant's whole trickle lands INSIDE
+    # the hog-saturated window (mean 35ms gaps) — offered load far
+    # past what num_slots can serve while the lights need service,
+    # which is the regime FIFO collapses in (lights arriving after
+    # the backlog drains would measure nothing)
+    hog_budgets = [
+        int(b) for b in rng.integers(
+            min(48, maxlen // 2 - 8), min(64, maxlen // 2) + 1,
+            size=n_hog,
+        )
+    ]
+    hog_at = np.cumsum(rng.exponential(0.010, n_hog))
+    light_at = np.cumsum(rng.exponential(0.035, n_light))
+    arrivals = sorted(
+        [
+            ("hog", hog_at[i],
+             rng.integers(1, vocab, size=hog_p).astype(np.int32),
+             hog_budgets[i])
+            for i in range(n_hog)
+        ] + [
+            ("light", light_at[i],
+             rng.integers(1, vocab, size=light_p).astype(np.int32),
+             light_mn)
+            for i in range(n_light)
+        ],
+        key=lambda a: a[1],
+    )
+    # admission bound: ~5 queued worst-case hogs, with one wave of
+    # light-tenant headroom on top so load shedding falls on the hog
+    # debt actually causing the overload
+    max_queue_tokens = 5 * (hog_p + max(hog_budgets)) + 64
+
+    def build(policy):
+        # BOTH arms run the identical paged + preemption engine — the
+        # comparison isolates the POLICY (FIFO order vs fair share +
+        # EDF + admission control composed with policy-derived
+        # preemption priority); without a policy nothing ever outranks
+        # anything, so the FIFO arm's preemption machinery never fires
+        return InferenceEngine(
+            model, num_slots=num_slots, steps_per_sync=1,
+            paged=True, block_size=block_size,
+            num_blocks=num_slots * blocks_for(maxlen, block_size),
+            preemption=True, policy=policy,
+        )
+
+    def warm(eng):
+        # compile every program the timed run touches, INCLUDING the
+        # preempt/resume pair (via the user priority knob, which works
+        # on both arms). Preemption only fires under genuine pressure,
+        # so fill EVERY slot with low-priority hogs first — and force
+        # BOTH offload/resume table-bucket shapes: a victim holding
+        # exactly its prompt's blocks (first token just landed) pads
+        # to a smaller id bucket than one a few tokens in, and either
+        # shape uncompiled would bill ~200ms of XLA to some timed
+        # request's TTFT
+        hogs = [
+            eng.submit(
+                rng.integers(1, vocab, size=hog_p).astype(np.int32), 6
+            )
+            for _ in range(num_slots)
+        ]
+        eng.step()  # all admitted: victims at the prompt-only bucket
+        eng.submit(
+            rng.integers(1, vocab, size=light_p).astype(np.int32), 2,
+            priority=1,
+        )
+        eng.step()  # preempt #1 (prompt-only bucket) + decode
+        eng.submit(
+            rng.integers(1, vocab, size=light_p).astype(np.int32), 2,
+            priority=1,
+        )
+        while eng.scheduler.has_work:  # preempt #2 (deeper bucket),
+            eng.step()                 # resumes at both buckets, drain
+        assert all(h.done and h.error is None for h in hogs)
+        stats = eng.stats()
+        assert stats["preemptions"] >= 2 and stats["resumes"] >= 2, (
+            "slo warmup failed to exercise the preempt/resume path"
+        )
+        # a light request ALONE drops the live block-table bucket to
+        # its smallest shape — a bucket the mixed warmup above never
+        # touches. The drained tail of the timed run (and the
+        # calibration probe) hits it, and an uncompiled bucket there
+        # would bill ~a second of XLA compile to some request's TTFT
+        eng.run([(
+            rng.integers(1, vocab, size=light_p).astype(np.int32), 2,
+        )])
+
+    # deadline calibration on a warmed, unloaded engine: the light
+    # deadline is a few unloaded TTFTs (tight but honestly meetable,
+    # and box-speed independent), the hog deadline looser — hogs fail
+    # by QUEUEING under overload, not by an impossible bar
+    cal = build(None)
+    warm(cal)
+    probe = cal.submit(
+        rng.integers(1, vocab, size=light_p).astype(np.int32), 2
+    )
+    cal.run()
+    unloaded_ttft_ms = probe.ttft * 1e3
+    cal.release_telemetry()
+    # the floor only guards against a sub-ms unloaded TTFT making the
+    # bar absurd; the 10x multiple is the real bar — tight enough that
+    # FIFO's queueing delay under the hog burst (hundreds of ms to
+    # seconds of saturation) blows it, loose enough that a policy-
+    # scheduled light request (one preemption + prefill away from its
+    # first token) clears it with margin on any box speed
+    # one TTFT SLO class for everyone: the hog's requests are not
+    # second-class, its problem is its own VOLUME — under FIFO its
+    # backlog blows the shared bar for both tenants, under the policy
+    # the shed tail pays while admitted requests (either tenant) meet it
+    light_deadline_ms = max(100.0, 10.0 * unloaded_ttft_ms)
+    hog_deadline_ms = light_deadline_ms
+
+    deadline = {"hog": hog_deadline_ms, "light": light_deadline_ms}
+
+    def drive(eng, with_slo):
+        reqs = []
+        t0 = time.perf_counter()
+        pending = list(arrivals)
+        while pending or eng.scheduler.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0][1] <= now:
+                tenant, _t, prompt, mn = pending.pop(0)
+                kw = (
+                    dict(tenant=tenant,
+                         ttft_deadline_ms=deadline[tenant])
+                    if with_slo else {}
+                )
+                reqs.append((tenant, eng.submit(prompt, mn, **kw)))
+            if eng.scheduler.has_work:
+                eng.step()
+            elif pending:
+                time.sleep(0.002)
+        dt = time.perf_counter() - t0
+        if dt <= MIN_CREDIBLE_DT:
+            raise ImplausibleTiming(
+                f"serving slo drive {dt:.4f}s below the "
+                f"{MIN_CREDIBLE_DT}s credibility floor"
+            )
+        return reqs, dt
+
+    def account(reqs):
+        met, rejected = 0, 0
+        light_ttfts = []
+        for tenant, r in reqs:
+            if r.error is not None:
+                rejected += 1
+                continue  # a shed request can never meet its deadline
+            if r.ttft is not None and (
+                r.ttft * 1e3 <= deadline[tenant]
+            ):
+                met += 1
+            if tenant == "light" and r.ttft is not None:
+                light_ttfts.append(r.ttft * 1e3)
+        return met, rejected, light_ttfts
+
+    # -- FIFO control arm (no policy; deadlines tracked host-side) -----
+    fifo_eng = build(None)
+    warm(fifo_eng)
+    fifo_reqs, fifo_dt = drive(fifo_eng, with_slo=False)
+    fifo_met, _fifo_rej, fifo_light = account(fifo_reqs)
+    fifo_eng.release_telemetry()
+
+    # -- policy arm: fair share + EDF + admission control --------------
+    pol = FairSharePolicy(
+        {"hog": 1.0, "light": 1.0},
+        max_queue_tokens=max_queue_tokens,
+        # waves tick per engine step (~ms here): the starvation
+        # backstop must stay far lazier than the deadline horizon, or
+        # promoted-but-unadmittable hog resumes head-block the lights
+        aging_waves=512,
+    )
+    pol_eng = build(pol)
+    warm(pol_eng)
+    pol_reqs, pol_dt = drive(pol_eng, with_slo=True)
+    pol_met, pol_rej, pol_light = account(pol_reqs)
+
+    # gate 3 FIRST (a starved request would also poison the other
+    # numbers): every admitted request finished, none starved
+    starved = [
+        r.rid for _t, r in pol_reqs
+        if r.error is None and not r.done
+    ]
+    if starved:
+        raise ImplausibleTiming(
+            f"slo gate: requests {starved} were admitted but never "
+            f"finished — the aging bound failed to prevent starvation"
+        )
+    # honesty cross-check: host accounting == registry SLO counters
+    s = pol_eng.stats()
+    counter_met = sum(
+        row["slo_met"] for row in s["tenants"].values()
+    )
+    if counter_met != pol_met:
+        raise ImplausibleTiming(
+            f"slo accounting drift: bench counted {pol_met} "
+            f"deadline-met requests, the engine's SLO counters say "
+            f"{counter_met} — one of the two comparison sites is wrong"
+        )
+    pol_eng.release_telemetry()
+
+    goodput_ratio = pol_met / max(1, fifo_met)
+    if pol_met < fifo_met * 1.5:
+        raise ImplausibleTiming(
+            f"slo gate: policy goodput {pol_met} vs FIFO {fifo_met} "
+            f"deadline-met requests ({goodput_ratio:.2f}x) under the "
+            f"1.5x floor — fair share + admission control is not "
+            f"buying goodput under overload"
+        )
+    fifo_p99 = float(np.percentile(fifo_light, 99))
+    pol_p99 = float(np.percentile(pol_light, 99))
+    if pol_p99 > 0.5 * fifo_p99:
+        raise ImplausibleTiming(
+            f"slo gate: light-tenant p99 TTFT {pol_p99:.0f}ms under "
+            f"the policy vs {fifo_p99:.0f}ms under FIFO — above the "
+            f"0.5x ceiling, the light tenant is not isolated from "
+            f"the hog"
+        )
+    return {
+        "offered_requests": len(arrivals),
+        "num_slots": num_slots,
+        "preemptions_policy": int(s["preemptions"]),
+        "goodput_fifo": fifo_met,
+        "goodput_policy": pol_met,
+        "goodput_ratio": round(goodput_ratio, 2),
+        "rejected_policy": pol_rej,
+        "starved_policy": 0,
+        "light_ttft_p99_ms_fifo": round(fifo_p99, 1),
+        "light_ttft_p99_ms_policy": round(pol_p99, 1),
+        "light_ttft_p99_ratio": round(pol_p99 / fifo_p99, 3),
+        "light_deadline_ms": round(light_deadline_ms, 1),
+        "hog_deadline_ms": round(hog_deadline_ms, 1),
+        "unloaded_ttft_ms": round(unloaded_ttft_ms, 2),
+        "max_queue_tokens": max_queue_tokens,
+        "drive_dt_fifo": round(fifo_dt, 3),
+        "drive_dt_policy": round(pol_dt, 3),
+    }
+
+
 def measure_serving(n_requests: int, num_slots: int, backend: str,
                     window: int = 8, chunk: int = 16):
     """``--preset serving`` (ISSUE 1): aggregate decode throughput of
@@ -1287,6 +1571,21 @@ def measure_serving(n_requests: int, num_slots: int, backend: str,
     # CPU split starves per-device compute threads, a distortion of
     # the very regime under measurement (_serving_specdec_subprocess).
     specdec = _serving_specdec_subprocess()
+    # SLO-aware scheduling under overload (ISSUE 10): FIFO vs
+    # fair-share + EDF + admission control on the same d128L4
+    # stand-in as the other latency sections — goodput is a deadline
+    # race, and the dispatch-bound toy's sub-ms steps would let even
+    # FIFO meet every deadline (no overload to measure)
+    slo = _serving_slo_section(lat_model, maxlen, lat_vocab)
+    log.info(
+        "serving slo (open-loop 2-tenant overload): goodput %d policy "
+        "vs %d FIFO (%.2fx, >=1.5x required), light-tenant p99 TTFT "
+        "%.0fms vs %.0fms (%.2fx, <=0.5x required), %d shed, 0 starved",
+        slo["goodput_policy"], slo["goodput_fifo"],
+        slo["goodput_ratio"], slo["light_ttft_p99_ms_policy"],
+        slo["light_ttft_p99_ms_fifo"], slo["light_ttft_p99_ratio"],
+        slo["rejected_policy"],
+    )
     log.info(
         "serving specdec (draft-and-verify, trained d64L2 stand-in): "
         "decode-only %.1f tok/s speculative vs %.1f plain (%.2fx, "
@@ -1371,6 +1670,7 @@ def measure_serving(n_requests: int, num_slots: int, backend: str,
         "telemetry": telemetry_overhead,
         "longctx": longctx,
         "specdec": specdec,
+        "slo": slo,
     }
 
 

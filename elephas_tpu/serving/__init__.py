@@ -36,7 +36,19 @@ blocks` — the paged arena (ISSUE 7, ``serve(paged=True)``): a global
   over either arena, and the longest greedy-matching prefix (plus a
   bonus token) lands per round — several tokens per target forward,
   temperature-0 output bit-exact, with a per-request acceptance
-  throttle so hostile text falls back to plain decode.
+  throttle so hostile text falls back to plain decode;
+- :mod:`elephas_tpu.serving.policy` — pluggable SLO admission
+  policies (ISSUE 10, ``serve(policy=, tenants=)``): VTC-style
+  per-tenant token-weighted fair share, deadline-EDF ordering with an
+  aging no-starvation bound, and overload admission control that
+  rejects loudly instead of queueing into a guaranteed timeout —
+  reordering and rejecting only, never touching decoding;
+- :mod:`elephas_tpu.serving.gateway` — the async HTTP/1.1 front door
+  (ISSUE 10, ``serve(gateway_port=)``): ``POST /v1/generate`` with
+  SSE token streaming over the per-request ``on_token`` hook,
+  ``GET /metrics`` / ``GET /stats``, 429 + Retry-After backpressure
+  from the policy's admission verdict, and sever-on-stop connection
+  hygiene.
 """
 
 from elephas_tpu.serving.blocks import BlockAllocator  # noqa: F401
@@ -64,4 +76,13 @@ from elephas_tpu.serving.speculative import (  # noqa: F401
     DraftModelDrafter,
     Drafter,
     NgramDrafter,
+)
+from elephas_tpu.serving.gateway import Gateway  # noqa: F401
+from elephas_tpu.serving.policy import (  # noqa: F401
+    DEFAULT_TENANT,
+    AdmissionRejected,
+    FairSharePolicy,
+    FifoPolicy,
+    Policy,
+    resolve_policy,
 )

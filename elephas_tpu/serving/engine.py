@@ -60,6 +60,11 @@ from elephas_tpu.serving.paged_kv import (
     table_bucket_for,
     table_buckets,
 )
+from elephas_tpu.serving.policy import (
+    DEFAULT_TENANT,
+    AdmissionRejected,
+    Policy,
+)
 from elephas_tpu.serving.speculative import (
     AcceptanceThrottle,
     resolve_drafter,
@@ -157,6 +162,15 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
     Works on both arenas; one verify program per window width (fixed)
     or (width, table bucket) pair (paged) keeps the shape set closed.
 
+    ``policy=`` (ISSUE 10) installs an SLO admission policy
+    (:mod:`~elephas_tpu.serving.policy`): per-tenant token-weighted
+    fair share, deadline-EDF ordering with aging, overload admission
+    control (loud :class:`~elephas_tpu.serving.policy.\
+AdmissionRejected` at submit), policy-derived preemption priority, and
+    tenant-labeled telemetry + SLO-attainment counters. The policy
+    reorders and rejects — it NEVER touches decoding, so temperature-0
+    token streams stay bit-exact per request under any policy.
+
     PP ring decode is not integrated yet — construct via
     ``SparkModel.serve()`` on a DP/TP mesh, or directly on no mesh.
     """
@@ -175,7 +189,8 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
                  preemption: bool = False,
                  speculative: bool = False,
                  spec_k: int | None = None,
-                 spec_drafter=None):
+                 spec_drafter=None,
+                 policy=None):
         import jax
         import jax.numpy as jnp
 
@@ -322,6 +337,15 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
                 )
             self.spec_k = k
 
+        # -- SLO admission policy (ISSUE 10) ---------------------------
+        if policy is not None and not isinstance(policy, Policy):
+            raise TypeError(
+                f"policy must be a serving.policy.Policy (or None), "
+                f"got {type(policy).__name__} — build one with "
+                f"FairSharePolicy(tenants=...) or resolve_policy()"
+            )
+        self.policy = policy
+
         if self.paged:
             self.arena = PagedKVPool(
                 flash_layers, self.num_blocks, self.block_size,
@@ -359,6 +383,7 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
             prefix_min_reuse=prefix_min_reuse,
             allocator=allocator,
             preemption=preemption,
+            policy=policy,
         )
         self._rules = rules
         self._seed = int(seed)
@@ -464,6 +489,66 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
             "Times a request's collapsed acceptance rate tripped the "
             "drafting throttle (fell back to plain decode)",
         )
+        # SLO scheduling (ISSUE 10): policy admission rejects (distinct
+        # from the paged never-fits counter — this one is load shed,
+        # not a capacity impossibility), plus tenant-labeled series.
+        # Families exist in EVERY mode so stats() keys never vary by
+        # config; children materialize per tenant label on first use.
+        self._m_admission_rejected = _c(
+            "elephas_serving_admission_rejected_total",
+            "Requests rejected at submit by the policy's overload "
+            "admission control (429 on the gateway)",
+        )
+
+        def _tc(name, help_):
+            return treg.counter(name, help_, labels=("engine", "tenant"))
+
+        self._mf_tenant_tokens = _tc(
+            "elephas_serving_tenant_tokens_total",
+            "Generated tokens emitted, by tenant",
+        )
+        self._mf_tenant_admitted = _tc(
+            "elephas_serving_tenant_admitted_total",
+            "Requests admitted into KV slots, by tenant",
+        )
+        self._mf_tenant_rejected = _tc(
+            "elephas_serving_tenant_rejected_total",
+            "Requests rejected at submit, by tenant (admission "
+            "control and paged never-fit alike)",
+        )
+        self._mf_slo_met = _tc(
+            "elephas_serving_slo_met_total",
+            "First tokens that landed within their declared TTFT "
+            "deadline, by tenant",
+        )
+        self._mf_slo_missed = _tc(
+            "elephas_serving_slo_missed_total",
+            "First tokens that landed after their declared TTFT "
+            "deadline, by tenant",
+        )
+        # per-tenant queue depth: callback gauges reading the live
+        # scheduler queue — scrape and stats() see the same truth with
+        # zero update plumbing (and zero chance of drift)
+        self._mf_tenant_queue = treg.gauge(
+            "elephas_serving_tenant_queue_depth",
+            "Waiting requests queued, by tenant",
+            labels=("engine", "tenant"),
+        )
+        if self.policy is not None:
+            sched = self.scheduler
+            for t in self.policy.tenant_names:
+                self._mf_tenant_queue.labels(
+                    engine=eid, tenant=t
+                ).set_function(lambda t=t: sched.waiting_count(t))
+                # materialize the zero-valued children now so a scrape
+                # before the first request already shows every tenant
+                for fam in (
+                    self._mf_tenant_tokens, self._mf_tenant_admitted,
+                    self._mf_tenant_rejected, self._mf_slo_met,
+                    self._mf_slo_missed,
+                ):
+                    fam.labels(engine=eid, tenant=t)
+
         treg.gauge(
             "elephas_serving_slots", "KV-cache slots in the arena",
             labels=("engine",),
@@ -842,6 +927,30 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
             AcceptanceThrottle() if self.speculative else None
         )
         self._spec_dirty = False
+        # HTTP/SSE front door (ISSUE 10): attached by
+        # ``SparkModel.serve(gateway_port=...)`` (or any host that
+        # builds a serving.gateway.Gateway around this engine); the
+        # engine's context-manager exit stops it and severs live SSE
+        # connections, so ``with model.serve(gateway_port=...) as eng:``
+        # can never leak a bound port or a zombie keep-alive handler
+        self.gateway = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop the attached gateway (if any): sever live SSE
+        connections, release the port, join its threads. Idempotent;
+        the engine itself stays usable in-process afterwards."""
+        gw = self.gateway
+        if gw is not None:
+            self.gateway = None
+            gw.stop()
+
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
     # -- device staging ------------------------------------------------
 
@@ -917,7 +1026,9 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
 
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0, eos_id: int | None = None,
-               on_token=None, priority: int = 0) -> Request:
+               on_token=None, priority: int = 0,
+               tenant: str | None = None,
+               ttft_deadline_ms: float | None = None) -> Request:
         """Queue one generation request (admitted at the next step —
         submission is legal at any time, including mid-flight). Every
         gang process must submit the identical sequence of requests.
@@ -931,7 +1042,21 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
         Paged mode: a request whose prompt + budget can NEVER fit the
         block pool is rejected loudly but GRACEFULLY — ``req.error``
         set, ``req.done`` True, never queued — instead of raising or
-        (worse) wedging the queue head forever at admission."""
+        (worse) wedging the queue head forever at admission.
+
+        SLO scheduling (ISSUE 10): ``tenant`` accounts the request
+        under a policy-declared tenant (fair share, per-tenant stats);
+        ``ttft_deadline_ms`` declares its time-to-first-token budget
+        (deadline-EDF ordering + SLO attainment counters). Both are
+        validated LOUDLY: an unknown tenant, a non-positive deadline,
+        or a deadline on an engine whose policy does not read
+        deadlines raises ValueError — silently recording either would
+        let the caller believe in isolation/urgency the scheduler
+        never delivers. A policy with admission control may refuse the
+        submit outright: like the paged never-fit case the request
+        comes back ``done`` with ``req.error`` set to
+        :class:`~elephas_tpu.serving.policy.AdmissionRejected`
+        (carrying the Retry-After hint the gateway serves as a 429)."""
         prompt = np.asarray(prompt).reshape(-1)
         p = len(prompt)
         if p < 1:
@@ -964,9 +1089,39 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
                 "(admission stays FIFO); serve with paged=True, "
                 "preemption=True for priority scheduling", priority,
             )
+        # SLO knob validation (ISSUE 10 satellite) — loud, per the
+        # docstring's contract
+        if tenant is not None:
+            if self.policy is None:
+                raise ValueError(
+                    f"submit(tenant={tenant!r}) on an engine without a "
+                    f"policy — serve with policy=/tenants= to declare "
+                    f"tenants before accounting requests under them"
+                )
+            if not self.policy.knows(tenant):
+                raise ValueError(
+                    f"unknown tenant {tenant!r} — declared tenants: "
+                    f"{sorted(self.policy.tenants) or '[none]'} (plus "
+                    f"the implicit {DEFAULT_TENANT!r})"
+                )
+        if ttft_deadline_ms is not None:
+            if not float(ttft_deadline_ms) > 0:
+                raise ValueError(
+                    f"ttft_deadline_ms={ttft_deadline_ms} must be "
+                    f"positive — a deadline at or before submit time "
+                    f"can never be met"
+                )
+            if self.policy is None or not self.policy.reads_deadlines:
+                raise ValueError(
+                    "submit(ttft_deadline_ms=) needs a deadline-aware "
+                    "policy (e.g. FairSharePolicy) — this engine's "
+                    "policy never reads deadlines, so the knob would "
+                    "be a silent no-op"
+                )
         req = self.scheduler.make_request(
             prompt, max_new_tokens, temperature=temperature, eos_id=eos_id,
-            on_token=on_token, priority=priority,
+            on_token=on_token, priority=priority, tenant=tenant,
+            ttft_deadline_ms=ttft_deadline_ms,
         )
         req.submit_time = time.perf_counter()
         if self.paged:
@@ -985,12 +1140,42 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
                 )
                 req.done = True
                 self._m_rejected.inc()
+                self._tenant_child(self._mf_tenant_rejected, tenant).inc()
+                logger.warning("%s", req.error)
+                self.finished[req.rid] = req
+                self._evict_finished()
+                return req
+        if self.policy is not None:
+            # overload admission control (ISSUE 10): the policy sees
+            # the queue's outstanding token debt and may shed THIS
+            # request now — loudly, with a deterministic Retry-After —
+            # instead of letting it time out at the back of a queue
+            # that can only grow
+            verdict = self.policy.admission_verdict(
+                req, self.scheduler.queued_tokens,
+                self.scheduler.queued_tokens_for(tenant),
+            )
+            if not verdict.admitted:
+                req.error = AdmissionRejected(
+                    f"request {req.rid} rejected by "
+                    f"{type(self.policy).__name__}: {verdict.reason}; "
+                    f"retry after {verdict.retry_after_s:.1f}s",
+                    retry_after_s=verdict.retry_after_s,
+                )
+                req.done = True
+                self._m_admission_rejected.inc()
+                self._tenant_child(self._mf_tenant_rejected, tenant).inc()
                 logger.warning("%s", req.error)
                 self.finished[req.rid] = req
                 self._evict_finished()
                 return req
         self.scheduler.submit(req)
         return req
+
+    def _tenant_child(self, family, tenant):
+        """The tenant-labeled child of ``family`` for this engine."""
+        label = DEFAULT_TENANT if tenant is None else str(tenant)
+        return family.labels(engine=self.telemetry_label, tenant=label)
 
     def _emit(self, req: Request, token: int) -> bool:
         """Record one generated token; reclaim + file the request when
@@ -1008,9 +1193,22 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
         # times stats() already reports — one recording site, no drift
         if len(req.token_times) == 1:
             if req.submit_time is not None:
-                self._m_ttft.observe(now - req.submit_time)
+                ttft = now - req.submit_time
+                self._m_ttft.observe(ttft)
+                if req.ttft_deadline_ms is not None:
+                    # SLO attainment (ISSUE 10): wall-clock TTFT meets
+                    # the declared budget HERE and only here — report-
+                    # only, never an input to the schedule
+                    met = ttft * 1e3 <= req.ttft_deadline_ms
+                    self._tenant_child(
+                        self._mf_slo_met if met else self._mf_slo_missed,
+                        req.tenant,
+                    ).inc()
         else:
             self._m_itl.observe(now - req.token_times[-2])
+        if self.policy is not None:
+            self.policy.on_token(req)
+            self._tenant_child(self._mf_tenant_tokens, req.tenant).inc()
         done = self.scheduler.on_token(slot, token)
         if req.on_token is not None:
             try:
@@ -1028,6 +1226,8 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
             self.scheduler.reclaim(slot)
             self._set_active(slot, False)
             self._m_finished.inc()
+            if self.policy is not None:
+                self.policy.on_finish(req)
             if self._spec_throttle is not None:
                 self._spec_throttle.forget(req.rid)
             self.finished[req.rid] = req
@@ -1448,6 +1648,18 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
             self._m_prefill_stalls.inc(stalled)
         return emitted
 
+    def _note_admissions(self, plan) -> None:
+        """Per-tenant admitted counters (ISSUE 10) — fresh admissions
+        only; a preemption resume was already counted when it first
+        entered a slot."""
+        if self.policy is None:
+            return
+        for a in plan:
+            if a.resume is None:
+                self._tenant_child(
+                    self._mf_tenant_admitted, a.req.tenant
+                ).inc()
+
     def step(self) -> list[tuple[Request, int, bool]]:
         """One engine iteration: admission of waiting requests into
         free slots (prefix-cache copies + prefill — full-wave, or
@@ -1471,12 +1683,14 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
             for pre in preempts:
                 self._offload(pre)
             if plan:
+                self._note_admissions(plan)
                 emitted.extend(self._admit_wave_paged(plan))
         else:
             plan = self.scheduler.admit()
             if plan:
                 # admission emissions land before any decode token, so
                 # req.done there is the prefill token's own flag
+                self._note_admissions(plan)
                 emitted.extend(self._admit_wave(plan))
         emitted.extend(self._prefill_progress())
         if not any(
@@ -1790,6 +2004,37 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
             "spec_k": self.spec_k,
         }
 
+    def _tenant_stats(self) -> dict:
+        """Per-tenant queue depth, admitted/rejected counts, token
+        totals, and SLO attainment — registry-backed (ISSUE 10
+        satellite). Empty without a policy (no tenants exist)."""
+        if self.policy is None:
+            return {}
+        out = {}
+        for t in self.policy.tenant_names:
+            met = int(self._tenant_child(self._mf_slo_met, t).value)
+            missed = int(
+                self._tenant_child(self._mf_slo_missed, t).value
+            )
+            out[t] = {
+                "queue_depth": self.scheduler.waiting_count(t),
+                "admitted": int(
+                    self._tenant_child(self._mf_tenant_admitted, t).value
+                ),
+                "rejected": int(
+                    self._tenant_child(self._mf_tenant_rejected, t).value
+                ),
+                "tokens": int(
+                    self._tenant_child(self._mf_tenant_tokens, t).value
+                ),
+                "slo_met": met,
+                "slo_missed": missed,
+                "slo_attainment": (
+                    met / (met + missed) if met + missed else None
+                ),
+            }
+        return out
+
     @staticmethod
     def _percentiles(xs) -> dict:
         """``{p50, p99, n}`` summary (seconds) of a latency sample."""
@@ -1858,7 +2103,15 @@ speculative.Drafter`) proposes up to ``spec_k`` tokens per slot and ONE
             ),
             "spec_verify_rounds": int(self._m_spec_rounds.value),
             "spec_throttled": int(self._m_spec_throttled.value),
+            # SLO scheduling (ISSUE 10): same one-store contract — the
+            # per-tenant section reads the registry children and the
+            # live scheduler queue, so stats() and a /metrics scrape
+            # can never drift
+            "admission_rejected": int(self._m_admission_rejected.value),
+            "tenants": self._tenant_stats(),
         }
+        if self.policy is not None:
+            out["policy"] = self.policy.stats()
         if self.paged:
             alloc = self.scheduler.allocator
             out["blocks_total"] = self.num_blocks

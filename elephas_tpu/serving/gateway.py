@@ -1,0 +1,568 @@
+"""Async HTTP/SSE front door over the serving engine (ISSUE 10).
+
+Before this module the only way into :class:`~elephas_tpu.serving.\
+engine.InferenceEngine` was an in-process ``submit()`` — fine for a
+notebook, useless for the "millions of users" north star. The
+:class:`Gateway` puts one wire in front of one engine:
+
+- ``POST /v1/generate`` — JSON body ``{"prompt": [ints],
+  "max_new_tokens": n, "temperature": t, "eos_id": e, "tenant": name,
+  "ttft_deadline_ms": ms, "stream": true}``. With ``stream`` (the
+  default) the response is Server-Sent Events riding the engine's
+  per-request ``on_token`` callback (the PR-3 streaming hook): one
+  ``data: {"token": t, "done": d}`` event per generated token after an
+  opening ``data: {"rid": id}`` event, then the connection closes.
+  ``stream: false`` buffers and returns one JSON document.
+- ``GET /metrics`` — the process registry through the PR-5 Prometheus
+  renderer (the same text an in-process ``engine.scrape()`` returns).
+- ``GET /stats`` — ``engine.stats()`` as JSON (per-tenant SLO section
+  included).
+
+Backpressure is the policy's admission verdict on the wire: a submit
+refused by overload admission control returns **429** with a
+``Retry-After`` header carrying the policy's deterministic hint —
+the gateway never buffers a request the scheduler already refused.
+Validation errors return 400 with the ValueError's message; the
+engine's graceful paged never-fit rejection returns 422 (the request
+can NEVER be served at this configuration — retrying is pointless,
+which is exactly what distinguishes it from the 429).
+
+Connection hygiene applies the ``utils/sockets.py`` lessons rather
+than growing a second ad-hoc transport stack: every read sits under a
+deadline (a half-open socket cannot pin a handler), every write goes
+through ``drain()`` (short-write safety under client backpressure),
+and :meth:`Gateway.stop` **severs live SSE connections** and releases
+the port — the same zombie keep-alive bug class PR 3 found in the
+parameter servers, fixed here by construction and pinned by a test
+that rebinds the port.
+
+Threading model: the asyncio loop runs in one daemon thread (socket
+I/O only — it never touches jax), a driver thread steps the engine
+whenever the scheduler has work, and a single lock serializes
+``submit()``/``step()`` on the engine (host bookkeeping; the device
+programs themselves are dispatched only from the driver thread).
+Tokens cross from the driver thread into the loop via
+``call_soon_threadsafe`` onto per-request asyncio queues.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+
+from elephas_tpu import telemetry
+from elephas_tpu.serving.policy import AdmissionRejected
+
+logger = logging.getLogger(__name__)
+
+#: Read deadline for request line / headers / body — a dead or
+#: dribbling client is cut loose instead of pinning a handler task
+#: (sockets.py: connections get deadlines, period).
+READ_TIMEOUT = 30.0
+#: Largest accepted request body; a prompt is a list of ints, so even
+#: maxlen-scale prompts sit far below this.
+MAX_BODY = 1 << 20
+
+_STATUS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    405: "Method Not Allowed", 408: "Request Timeout",
+    413: "Payload Too Large", 422: "Unprocessable Entity",
+    429: "Too Many Requests", 500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+def _response(code: int, body: bytes, content_type: str,
+              extra_headers=()) -> bytes:
+    head = [
+        f"HTTP/1.1 {code} {_STATUS.get(code, 'Unknown')}",
+        f"Content-Type: {content_type}",
+        f"Content-Length: {len(body)}",
+        "Connection: close",
+    ]
+    head.extend(f"{k}: {v}" for k, v in extra_headers)
+    return ("\r\n".join(head) + "\r\n\r\n").encode("ascii") + body
+
+
+def _json_response(code: int, obj, extra_headers=()) -> bytes:
+    return _response(
+        code, json.dumps(obj).encode("utf-8") + b"\n",
+        "application/json", extra_headers,
+    )
+
+
+class _HttpError(Exception):
+    """Maps straight to one non-200 response."""
+
+    def __init__(self, code: int, message: str, extra_headers=()):
+        super().__init__(message)
+        self.code = code
+        self.extra_headers = tuple(extra_headers)
+
+
+class Gateway:
+    """One HTTP/SSE front door over one engine. ``port=0`` binds an
+    ephemeral port (read :attr:`port` after :meth:`start`). Use as a
+    context manager, or pair :meth:`start`/:meth:`stop` — stop severs
+    live SSE connections and releases the port."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 read_timeout: float = READ_TIMEOUT,
+                 max_body: int = MAX_BODY):
+        self.engine = engine
+        self.host = host
+        self._want_port = int(port)
+        self.port: int | None = None
+        self.read_timeout = float(read_timeout)
+        self.max_body = int(max_body)
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._loop_thread: threading.Thread | None = None
+        self._driver_thread: threading.Thread | None = None
+        # serializes engine.submit() (loop thread) vs engine.step()
+        # (driver thread) — both are host bookkeeping; device dispatch
+        # stays on the driver side of this lock
+        self._engine_lock = threading.Lock()
+        self._work = threading.Event()
+        self._stopping = threading.Event()
+        # _stopping means "no new work" (the driver's crash path sets
+        # it too); _stopped is the one-shot teardown latch — stop()
+        # must still run its full teardown after a driver crash, or
+        # the port and live handlers would leak exactly the way the
+        # module docstring promises they cannot
+        self._stopped = False
+        self._stop_lock = threading.Lock()
+        self._started = False
+        # live handler tasks + writers, so stop() can sever them
+        self._tasks: set[asyncio.Task] = set()
+        self._writers: set[asyncio.StreamWriter] = set()
+        # telemetry (engine-label family set: release_telemetry on the
+        # gateway retires only its own series)
+        reg = telemetry.registry()
+        self._tracer = telemetry.tracer()
+        gid = telemetry.instance_label()
+        self.telemetry_label = gid
+        self._m_requests = reg.counter(
+            "elephas_gateway_requests_total",
+            "HTTP requests served by the gateway, by route and status",
+            labels=("gateway", "route", "code"),
+        )
+        self._m_sse_active = reg.gauge(
+            "elephas_gateway_sse_active",
+            "SSE token streams currently open",
+            labels=("gateway",),
+        ).labels(gateway=gid)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "Gateway":
+        if self._started:
+            raise RuntimeError("gateway already started")
+        self._started = True
+        ready = threading.Event()
+        boot_err: list[BaseException] = []
+
+        def loop_main():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            try:
+                self._server = loop.run_until_complete(
+                    asyncio.start_server(
+                        self._handle, self.host, self._want_port
+                    )
+                )
+            except OSError as e:  # port in use, bad host, ...
+                boot_err.append(e)
+                loop.close()  # else the selector fd leaks until GC
+                ready.set()
+                return
+            self.port = self._server.sockets[0].getsockname()[1]
+            ready.set()
+            try:
+                loop.run_forever()
+            finally:
+                # loop.stop() ran inside _shutdown(); the server and
+                # every transport are already closed there
+                loop.close()
+
+        self._loop_thread = threading.Thread(
+            target=loop_main, name="gateway-loop", daemon=True
+        )
+        self._loop_thread.start()
+        ready.wait()
+        if boot_err:
+            self._started = False
+            raise boot_err[0]
+        self._driver_thread = threading.Thread(
+            target=self._drive, name="gateway-driver", daemon=True
+        )
+        self._driver_thread.start()
+        logger.info(
+            "gateway listening on %s:%d (engine %s)",
+            self.host, self.port, self.engine.telemetry_label,
+        )
+        return self
+
+    def stop(self) -> None:
+        """Sever everything: stop the driver, close the listener and
+        EVERY live connection (SSE streams included), stop the loop,
+        join both threads, release the port. Idempotent — and runs
+        its full teardown even when the driver already crashed (the
+        crash path only flags ``_stopping``; this is the half that
+        actually releases the port)."""
+        if not self._started:
+            return
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self._stopping.set()
+        self._work.set()  # wake the driver so it can observe stopping
+        dt = self._driver_thread
+        if dt is not None and dt is not threading.current_thread():
+            dt.join(timeout=30)
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+            done = threading.Event()
+            loop.call_soon_threadsafe(
+                lambda: loop.create_task(self._shutdown(done))
+            )
+            done.wait(timeout=30)
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=30)
+        logger.info("gateway on port %s stopped", self.port)
+
+    async def _shutdown(self, done: threading.Event) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            if self._server is not None:
+                self._server.close()
+                await self._server.wait_closed()
+            # sever live SSE connections — the zombie keep-alive bug
+            # class (PR 3, parameter servers): a handler mid-stream
+            # must not outlive the gateway
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except OSError:
+                    pass  # fault-lint: allow — already-dead transport
+            for t in list(self._tasks):
+                t.cancel()
+            if self._tasks:
+                await asyncio.gather(
+                    *list(self._tasks), return_exceptions=True
+                )
+        finally:
+            done.set()
+            loop.stop()
+
+    def release_telemetry(self) -> None:
+        """Retire this gateway's labeled series (explicit-only, same
+        contract as the engine's)."""
+        telemetry.remove_series(gateway=self.telemetry_label)
+
+    def __enter__(self) -> "Gateway":
+        return self.start() if not self._started else self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    # -- engine driver --------------------------------------------------
+
+    def _drive(self) -> None:
+        """Step the engine whenever the scheduler has work; park on an
+        event otherwise (a submit sets it). Any engine error severs the
+        gateway LOUDLY — serving garbage quietly is the one thing a
+        front door must never do."""
+        try:
+            while not self._stopping.is_set():
+                with self._engine_lock:
+                    has_work = self.engine.scheduler.has_work
+                    if has_work:
+                        self.engine.step()
+                if not has_work:
+                    self._work.wait(timeout=0.05)
+                    self._work.clear()
+        except Exception:
+            logger.exception(
+                "gateway driver died mid-step — severing the gateway "
+                "(in-flight streams will close)"
+            )
+            # run the REAL teardown, not just the flag: in-flight
+            # handlers are parked on queues no tokens will ever reach
+            # again, and the port must come back. stop() skips joining
+            # the current (driver) thread.
+            self.stop()
+
+    # -- request handling (loop thread) ---------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._tasks.add(task)
+        self._writers.add(writer)
+        route, code = "other", 500
+        try:
+            try:
+                # ONE deadline over the whole request read: the
+                # per-line timeouts inside cannot bound a client that
+                # dribbles a header every few seconds forever
+                method, path, body = await asyncio.wait_for(
+                    self._read_request(reader), self.read_timeout
+                )
+                route = self._route_label(method, path)
+                with self._tracer.span("gateway.request", route=route):
+                    code = await self._route(
+                        method, path, body, writer
+                    )
+            except _HttpError as e:
+                code = e.code
+                await self._write(writer, _json_response(
+                    e.code, {"error": str(e)}, e.extra_headers
+                ))
+            except asyncio.TimeoutError:
+                code = 408
+                await self._write(writer, _json_response(
+                    408, {"error": "request read timed out"}
+                ))
+        except (ConnectionError, OSError) as e:
+            logger.info("gateway connection dropped (%r)", e)
+        except asyncio.CancelledError:
+            # stop() severing us — close fast, propagate nothing
+            pass  # fault-lint: allow — deliberate sever on stop()
+        except Exception:
+            logger.exception("gateway handler failed")
+            code = 500
+        finally:
+            self._m_requests.labels(
+                gateway=self.telemetry_label, route=route,
+                code=str(code),
+            ).inc()
+            self._writers.discard(writer)
+            self._tasks.discard(task)
+            try:
+                writer.close()
+            except OSError:
+                pass  # fault-lint: allow — already-severed transport
+
+    @staticmethod
+    def _route_label(method: str, path: str) -> str:
+        """Metric label for the route — KNOWN (method, path) pairs
+        only, everything else collapses to "other": no part of the
+        label value may be client-controlled, or a scanner walking
+        paths (or inventing METHOD tokens on real paths) mints
+        unbounded registry series."""
+        route = f"{method} {path.split('?', 1)[0]}"
+        if route in (
+            "POST /v1/generate", "GET /metrics", "GET /stats",
+        ):
+            return route
+        return "other"
+
+    async def _read_request(self, reader):
+        # no per-read deadlines here: the caller wraps this WHOLE
+        # coroutine in one wait_for(read_timeout), which is the bound
+        # that actually governs (per-line timeouts could never cut a
+        # client dribbling one header per interval loose)
+        line = await reader.readline()
+        if not line:
+            raise _HttpError(400, "empty request")
+        try:
+            method, path, _version = line.decode("ascii").split()
+        except ValueError:
+            raise _HttpError(400, f"malformed request line {line!r}")
+        headers = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            if len(headers) >= 128:
+                raise _HttpError(400, "too many headers")
+            if b":" in h:
+                k, v = h.split(b":", 1)
+                headers[k.strip().lower().decode("ascii")] = (
+                    v.strip().decode("latin-1")
+                )
+        body = b""
+        if method == "POST":
+            try:
+                n = int(headers.get("content-length", "0"))
+            except ValueError:
+                raise _HttpError(400, "bad Content-Length")
+            if n > self.max_body:
+                raise _HttpError(
+                    413, f"body of {n} bytes exceeds {self.max_body}"
+                )
+            if n:
+                body = await reader.readexactly(n)
+        return method, path, body
+
+    async def _write(self, writer, data: bytes) -> None:
+        # sockets.py lesson: sendall/drain after every write — a slow
+        # consumer backpressures the handler, never silently truncates
+        writer.write(data)
+        await writer.drain()
+
+    async def _route(self, method, path, body, writer) -> int:
+        path = path.split("?", 1)[0]
+        if path == "/v1/generate":
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            return await self._generate(body, writer)
+        if path == "/metrics":
+            if method != "GET":
+                raise _HttpError(405, "GET only")
+            text = telemetry.render().encode("utf-8")
+            await self._write(writer, _response(
+                200, text, "text/plain; version=0.0.4; charset=utf-8"
+            ))
+            return 200
+        if path == "/stats":
+            if method != "GET":
+                raise _HttpError(405, "GET only")
+            loop = asyncio.get_running_loop()
+
+            def snapshot():
+                # off-loop: the lock may be held by a long engine step
+                # and must not freeze the event loop while we wait
+                with self._engine_lock:
+                    return json.dumps(
+                        self.engine.stats(), default=float
+                    ).encode("utf-8") + b"\n"
+
+            body = await loop.run_in_executor(None, snapshot)
+            await self._write(writer, _response(
+                200, body, "application/json"
+            ))
+            return 200
+        raise _HttpError(404, f"no route {path}")
+
+    def _parse_generate(self, body: bytes) -> dict:
+        try:
+            spec = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise _HttpError(400, f"bad JSON body: {e}")
+        if not isinstance(spec, dict):
+            raise _HttpError(400, "body must be a JSON object")
+        unknown = set(spec) - {
+            "prompt", "max_new_tokens", "temperature", "eos_id",
+            "tenant", "ttft_deadline_ms", "priority", "stream",
+        }
+        if unknown:
+            raise _HttpError(400, f"unknown fields {sorted(unknown)}")
+        if "prompt" not in spec or "max_new_tokens" not in spec:
+            raise _HttpError(
+                400, "prompt and max_new_tokens are required"
+            )
+        return spec
+
+    async def _generate(self, body, writer) -> int:
+        spec = self._parse_generate(body)
+        stream = bool(spec.pop("stream", True))
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_token(token, done):
+            loop.call_soon_threadsafe(
+                q.put_nowait, (int(token), bool(done))
+            )
+
+        def do_submit():
+            # off-loop: the engine lock may be held by a long step()
+            # (or a first-call compile) — waiting for it must block
+            # THIS request only, not the whole event loop
+            with self._engine_lock:
+                if self._stopping.is_set():
+                    raise _HttpError(503, "gateway is stopping")
+                return self.engine.submit(
+                    spec["prompt"], spec["max_new_tokens"],
+                    temperature=float(spec.get("temperature", 0.0)),
+                    eos_id=spec.get("eos_id"),
+                    tenant=spec.get("tenant"),
+                    ttft_deadline_ms=spec.get("ttft_deadline_ms"),
+                    priority=int(spec.get("priority", 0)),
+                    on_token=on_token,
+                )
+
+        try:
+            req = await loop.run_in_executor(None, do_submit)
+        except (ValueError, TypeError) as e:
+            raise _HttpError(400, str(e))
+        if req.error is not None:
+            # rejected at submit — backpressure on the wire
+            if isinstance(req.error, AdmissionRejected):
+                raise _HttpError(
+                    429, str(req.error),
+                    extra_headers=(
+                        ("Retry-After",
+                         str(max(1, round(req.error.retry_after_s)))),
+                    ),
+                )
+            raise _HttpError(422, str(req.error))
+        self._work.set()  # wake the driver
+        if stream:
+            return await self._stream_sse(req, q, writer)
+        return await self._respond_once(req, q, writer)
+
+    async def _drain_tokens(self, req, q) -> list:
+        tokens = []
+        while True:
+            token, done = await q.get()
+            tokens.append(token)
+            if done:
+                return tokens
+
+    async def _respond_once(self, req, q, writer) -> int:
+        tokens = await self._drain_tokens(req, q)
+        payload = {
+            "rid": req.rid,
+            "tokens": tokens,
+            "full_sequence": list(req.prompt) + list(req.tokens),
+            "error": None if req.error is None else str(req.error),
+        }
+        await self._write(writer, _json_response(200, payload))
+        return 200
+
+    async def _stream_sse(self, req, q, writer) -> int:
+        head = (
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        self._m_sse_active.inc()
+        try:
+            await self._write(writer, head)
+            await self._write(writer, _sse_event({"rid": req.rid}))
+            while True:
+                token, done = await q.get()
+                await self._write(
+                    writer, _sse_event({"token": token, "done": done})
+                )
+                if done:
+                    break
+            final = {
+                "rid": req.rid,
+                "n_tokens": len(req.tokens),
+                "error": None if req.error is None else str(req.error),
+            }
+            await self._write(writer, _sse_event(final, event="done"))
+        except (ConnectionError, OSError) as e:
+            # client went away mid-stream: the engine finishes the
+            # request on its own (tokens drop into a queue nobody
+            # reads, freed with the handler) — log and close
+            logger.info(
+                "SSE client for request %d disconnected mid-stream "
+                "(%r)", req.rid, e,
+            )
+        finally:
+            self._m_sse_active.dec()
+        return 200
+
+
+def _sse_event(obj, event: str | None = None) -> bytes:
+    prefix = f"event: {event}\n" if event else ""
+    return (prefix + "data: " + json.dumps(obj) + "\n\n").encode("utf-8")

@@ -8,12 +8,17 @@ every gang process runs the identical deterministic schedule from the
 identical submission order (the same contract ``generate()`` already
 imposes).
 
-Admission is greedy FIFO into free slots at every step boundary
-(requests submitted mid-flight join the next step's admission wave —
-no generation "epoch" to wait for), and slots reclaim the moment a
+Admission is greedy into free slots at every step boundary (requests
+submitted mid-flight join the next step's admission wave — no
+generation "epoch" to wait for), and slots reclaim the moment a
 sequence hits EOS or its token budget, so the freed compute is re-used
 by the very next waiting request instead of idling until the batch
-drains.
+drains. The admission ORDER is FIFO by default and pluggable through
+an SLO policy (ISSUE 10, :mod:`elephas_tpu.serving.policy`): the
+policy reorders the waiting queue before every admission attempt
+(fair share / deadline EDF / aging) and supplies the effective
+preemption priority — all host-side, all deterministic, so the gang
+contract is untouched.
 
 Prompt lengths are padded up to a fixed **bucket ladder**
 (:func:`default_buckets`: powers of two, capped at the model's
@@ -77,8 +82,17 @@ class Request:
     eos_id: int | None = None
     # scheduling priority (paged preemption, ISSUE 7): an arriving
     # request may preempt active requests of STRICTLY lower priority
-    # when the block pool is exhausted; equal priorities never preempt
+    # when the block pool is exhausted; equal priorities never preempt.
+    # With a policy installed (ISSUE 10) the comparisons read the
+    # policy's priority_of() instead — this field is the caller's base.
     priority: int = 0
+    # SLO scheduling (ISSUE 10): the tenant this request accounts
+    # under (None = the implicit default tenant) and its declared
+    # time-to-first-token budget. The deadline orders the schedule as
+    # a CLASS (tighter budget first — logical, gang-deterministic);
+    # wall-clock attainment is measured in telemetry only.
+    tenant: str | None = None
+    ttft_deadline_ms: float | None = None
     tokens: list = field(default_factory=list)
     slot: int | None = None
     done: bool = False
@@ -160,13 +174,21 @@ class Scheduler:
 
     def __init__(self, num_slots: int, buckets, prefix_cache: bool = False,
                  prefix_min_reuse: int = 1, allocator=None,
-                 preemption: bool = False):
+                 preemption: bool = False, policy=None):
         self.num_slots = int(num_slots)
         self.buckets = tuple(sorted(int(b) for b in buckets))
         self.waiting: deque[Request] = deque()
         self.active: dict[int, Request] = {}
         self._free: list[int] = list(range(self.num_slots))
         self._ids = itertools.count()
+        # SLO admission policy (ISSUE 10): None keeps the bare-FIFO
+        # fast path byte-for-byte; a policy gets the reorder/accounting
+        # hooks documented in serving.policy
+        self.policy = policy
+        # outstanding token debt of the waiting queue (prompt +
+        # remaining budget, summed) — the policy's admission-control
+        # input, maintained incrementally at every enqueue/dequeue
+        self.queued_tokens = 0
         # paged mode (ISSUE 7): an allocator switches admission from
         # slot-only leasing to slot+block leasing; the prefix cache
         # becomes a block-refcount index (copy-free splices) instead of
@@ -237,15 +259,26 @@ class Scheduler:
 
     # -- submission ----------------------------------------------------
 
+    @staticmethod
+    def _debt(req: Request) -> int:
+        """Tokens this request still owes the engine (prompt +
+        remaining budget) — frozen while it waits, so enqueue/dequeue
+        adjustments are exactly symmetric."""
+        return len(req.prompt) + req.max_new_tokens - len(req.tokens)
+
     def submit(self, request: Request) -> Request:
         request.rid = next(self._ids) if request.rid is None else request.rid
         self.waiting.append(request)
+        self.queued_tokens += self._debt(request)
+        if self.policy is not None:
+            self.policy.on_submit(request)
         self._m_waiting.set(len(self.waiting))
         return request
 
     def make_request(self, prompt, max_new_tokens, temperature=0.0,
                      eos_id=None, on_token=None,
-                     priority: int = 0) -> Request:
+                     priority: int = 0, tenant: str | None = None,
+                     ttft_deadline_ms: float | None = None) -> Request:
         return Request(
             rid=next(self._ids),
             prompt=tuple(int(t) for t in prompt),
@@ -254,7 +287,64 @@ class Scheduler:
             eos_id=None if eos_id is None else int(eos_id),
             on_token=on_token,
             priority=int(priority),
+            tenant=tenant,
+            ttft_deadline_ms=(
+                None if ttft_deadline_ms is None else float(ttft_deadline_ms)
+            ),
         )
+
+    def waiting_count(self, tenant: str) -> int:
+        """Waiting requests accounted under ``tenant`` (the per-tenant
+        queue-depth gauges read this live — no cached copy to drift)."""
+        from elephas_tpu.serving.policy import DEFAULT_TENANT
+
+        return sum(
+            1 for r in self.waiting
+            if (r.tenant if r.tenant is not None else DEFAULT_TENANT)
+            == tenant
+        )
+
+    def queued_tokens_for(self, tenant: str | None) -> int:
+        """The waiting queue's token debt owed by ONE tenant — the
+        policy's per-tenant admission-control input. Computed live
+        over the (small) queue rather than cached: one truth, no
+        incremental-bookkeeping drift."""
+        from elephas_tpu.serving.policy import DEFAULT_TENANT
+
+        t = DEFAULT_TENANT if tenant is None else tenant
+        return sum(
+            self._debt(r) for r in self.waiting
+            if (r.tenant if r.tenant is not None else DEFAULT_TENANT)
+            == t
+        )
+
+    def _prio(self, req: Request) -> int:
+        """Preemption-effective priority: the policy's view when one
+        is installed (ISSUE 10 — deadline traffic may outrank
+        best-effort), the caller's submit(priority=) otherwise."""
+        if self.policy is not None:
+            return self.policy.priority_of(req)
+        return req.priority
+
+    def _policy_reorder(self) -> None:
+        """Let the policy re-rank the waiting queue before an
+        admission attempt; preempted requests stay pinned at the
+        front (their host-offloaded K/V resumes as soon as space
+        frees)."""
+        if self.policy is not None:
+            self.policy.reorder(self.waiting, self._preempted)
+
+    def _dequeue_head(self) -> Request:
+        """Pop the queue head into an admission: debt drops and the
+        policy charges the prefill (a resume re-admission charges
+        nothing — its prompt was already served once)."""
+        req = self.waiting.popleft()
+        self.queued_tokens -= self._debt(req)
+        if self.policy is not None:
+            self.policy.on_admit(
+                req, resumed=req.rid in self._preempted
+            )
+        return req
 
     # -- per-step decisions --------------------------------------------
 
@@ -275,7 +365,14 @@ class Scheduler:
         admitted: list[Admission] = []
         pinned: list[int] = []
         cache = self.prefix_cache
+        if self.policy is not None:
+            self.policy.begin_wave()
         while self.waiting:
+            # re-rank before EVERY attempt: an admission earlier in
+            # this wave charged its tenant's counter, and the next
+            # head must reflect that (otherwise one wave would drain
+            # a whole tenant before fairness reacts)
+            self._policy_reorder()
             req = self.waiting[0]
             donor, reuse = (None, 0)
             if cache is not None:
@@ -301,7 +398,7 @@ class Scheduler:
                     slot = cache.evict_lru()
                 if slot is None:
                     break  # genuinely full — request keeps waiting
-            self.waiting.popleft()
+            self._dequeue_head()
             if cache is not None:
                 cache.remove(slot)  # rows are about to be overwritten
                 if donor is not None:
@@ -358,8 +455,15 @@ class Scheduler:
             raise RuntimeError("admit_paged() on a non-paged scheduler")
         admitted: list[Admission] = []
         preempts: list[Preemption] = []
+        # rids admitted by THIS wave — never preemption victims within
+        # it (their Admission is already in the returned plan; see
+        # _plan_preemption)
+        wave_rids: set[int] = set()
         alloc, idx = self.allocator, self.prefix_index
+        if self.policy is not None:
+            self.policy.begin_wave()
         while self.waiting:
+            self._policy_reorder()
             req = self.waiting[0]
             need_total = self.blocks_needed(req)
             record = self._preempted.get(req.rid)
@@ -378,7 +482,8 @@ class Scheduler:
             if short > 0 or not self._free:
                 if self.preemption:
                     plan = self._plan_preemption(
-                        req, short, bool(self._free), prefilling
+                        req, short, bool(self._free), prefilling,
+                        wave_rids,
                     )
                 if not plan:
                     break  # head keeps waiting; nothing may jump it
@@ -386,7 +491,7 @@ class Scheduler:
             # executing preemptions, so victims re-queue at the front
             # of the REMAINING queue (not ahead of the head — that
             # would make the wave pop the victim instead)
-            self.waiting.popleft()
+            self._dequeue_head()
             for victim in plan:
                 preempts.append(self._preempt(victim))
             shared: list[int] = []
@@ -401,6 +506,7 @@ class Scheduler:
             self.tables_version += 1
             req.slot = slot
             self.active[slot] = req
+            wave_rids.add(req.rid)
             if record is not None:
                 self._preempted.pop(req.rid)
                 self._m_admit_resume.inc()
@@ -420,26 +526,30 @@ class Scheduler:
         return admitted, preempts
 
     def _plan_preemption(self, req: Request, short: int,
-                         have_slot: bool, prefilling):
+                         have_slot: bool, prefilling, wave_rids):
         """Choose victims that would admit ``req`` — or none at all.
         Eligible: active, strictly lower priority, NOT mid-prefill,
-        and holding at least one generated token — a request with no
-        token yet has no resident state an offload could represent
-        (its prefill has not finalized), and crucially that guard
-        covers admissions made EARLIER IN THIS SAME WAVE: their
-        Admission is already in the returned plan, so preempting them
-        would double-lease their blocks and hand the engine a plan
-        that prefills into a revoked slot. Order: lowest priority
-        first, then youngest (largest rid) — the oldest work at each
-        priority is preserved longest. Only blocks whose last
-        reference is the victim's table count as freed (prefix-shared
-        blocks survive via their index entry)."""
+        NOT admitted by this same wave (``wave_rids``: their Admission
+        is already in the returned plan, so preempting them would
+        double-lease their blocks — and for a RESUME admission, pop
+        the engine's one offload record twice), and holding at least
+        one generated token — a request with no token yet has no
+        resident state an offload could represent (its prefill has
+        not finalized). The token guard alone used to stand in for
+        the same-wave rule, but a resume admitted earlier in the wave
+        HAS tokens, which is exactly how a policy-boosted head
+        exposed the hole. Order: lowest priority first, then youngest
+        (largest rid) — the oldest work at each priority is preserved
+        longest. Only blocks whose last reference is the victim's
+        table count as freed (prefix-shared blocks survive via their
+        index entry)."""
+        head_prio = self._prio(req)
         cands = [
             r for slot, r in self.active.items()
-            if r.priority < req.priority and slot not in prefilling
-            and r.tokens
+            if self._prio(r) < head_prio and slot not in prefilling
+            and r.tokens and r.rid not in wave_rids
         ]
-        cands.sort(key=lambda r: (r.priority, -r.rid))
+        cands.sort(key=lambda r: (self._prio(r), -r.rid))
         chosen, freed, slots_freed = [], 0, 0
         for r in cands:
             if freed >= short and (have_slot or slots_freed > 0):
@@ -480,6 +590,15 @@ class Scheduler:
         self.allocator.deref(table)
         self._preempted[req.rid] = rec
         self.waiting.appendleft(req)
+        # back on the queue, back in the debt — _debt() deliberately
+        # re-counts the prompt: the victim's claim on future capacity
+        # includes re-residency for its prompt blocks, not just the
+        # remaining budget (already-generated tokens are the only part
+        # that never comes back); on_preempt (not on_submit) tells the
+        # policy: re-arm aging, no counter lift
+        self.queued_tokens += self._debt(req)
+        if self.policy is not None:
+            self.policy.on_preempt(req)
         return rec
 
     def on_prefill_complete(self, req: Request) -> None:

@@ -1203,6 +1203,10 @@ class SparkModel:
         speculative: bool = False,
         spec_k: int | None = None,
         spec_drafter=None,
+        policy=None,
+        tenants=None,
+        gateway_port: int | None = None,
+        gateway_host: str = "127.0.0.1",
     ):
         """A continuous-batching :class:`~elephas_tpu.serving.engine.\
 InferenceEngine` over this wrapper's mesh — the serving analogue of
@@ -1237,8 +1241,21 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
         to ``spec_k`` tokens per slot per round and one batched verify
         forward accepts the longest greedy-matching prefix — multiple
         tokens per target forward, temperature-0 output bit-exact.
+
+        ``policy=`` / ``tenants=`` (ISSUE 10) install an SLO admission
+        policy: ``"fair"`` (or just ``tenants={"name": weight}``) gets
+        VTC-style per-tenant fair share + deadline-EDF + overload
+        admission control, ``"fifo"`` the legacy order with tenant
+        accounting, or pass a :class:`~elephas_tpu.serving.policy.\
+Policy` instance. ``gateway_port=`` (0 = ephemeral) additionally
+        starts the async HTTP/SSE front door on the engine
+        (``POST /v1/generate``, ``GET /metrics``, ``GET /stats``; see
+        :mod:`elephas_tpu.serving.gateway`). The returned engine is a
+        context manager: leaving the ``with`` block stops the gateway,
+        severs live SSE connections, and releases the port.
         """
         from elephas_tpu.serving import InferenceEngine
+        from elephas_tpu.serving.policy import resolve_policy
 
         if self.pipeline_parallel > 1:
             raise NotImplementedError(
@@ -1248,7 +1265,7 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
                 "generate() for one-shot ring decode)"
             )
         batch_axes, model_axis = self._decode_axes()
-        return InferenceEngine(
+        engine = InferenceEngine(
             self._master_network,
             num_slots=num_slots,
             mesh=self.mesh,
@@ -1270,7 +1287,26 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
             speculative=speculative,
             spec_k=spec_k,
             spec_drafter=spec_drafter,
+            policy=resolve_policy(policy, tenants),
         )
+        if gateway_port is not None:
+            from elephas_tpu.serving.gateway import Gateway
+
+            gw = Gateway(
+                engine, host=gateway_host, port=int(gateway_port)
+            )
+            try:
+                engine.gateway = gw.start()
+            except Exception:
+                # a start() failure (port in use) means the caller
+                # never receives the engine — retire BOTH the
+                # engine's and the half-built gateway's telemetry
+                # series before re-raising, or every retry strands
+                # labeled families in the process registry
+                gw.release_telemetry()
+                engine.release_telemetry()
+                raise
+        return engine
 
     # -- persistence ---------------------------------------------------
 

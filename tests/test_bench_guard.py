@@ -269,6 +269,18 @@ class TestFaultPathLint:
             f.endswith(os.path.join("serving", "speculative.py"))
             for f in files
         )
+        # ISSUE 10: the gateway is a NETWORK fault path (half-open
+        # sockets, client aborts mid-SSE) — a swallowed error there is
+        # a silent dropped stream or a leaked handler; and the policy
+        # orders a gang-replicated schedule, so an eaten error forks it
+        assert any(
+            f.endswith(os.path.join("serving", "gateway.py"))
+            for f in files
+        )
+        assert any(
+            f.endswith(os.path.join("serving", "policy.py"))
+            for f in files
+        )
         return root, files
 
     def test_no_bare_or_swallowed_excepts_on_fault_paths(self):
@@ -332,6 +344,18 @@ class TestTelemetryWallClockLint:
         # gang — wall clock in them would fork the schedule the same way
         assert any(
             f.endswith(os.path.join("serving", "speculative.py"))
+            for f in files
+        )
+        # ISSUE 10: the policy's fair-share/EDF/aging order IS the
+        # schedule — it runs on logical clocks (waves, token counts,
+        # declared deadline classes) by contract, and the gateway must
+        # not smuggle wall time into submit ordering either
+        assert any(
+            f.endswith(os.path.join("serving", "policy.py"))
+            for f in files
+        )
+        assert any(
+            f.endswith(os.path.join("serving", "gateway.py"))
             for f in files
         )
         offences = []
