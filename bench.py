@@ -1031,6 +1031,162 @@ def _serving_specdec_section(rounds=5, spec_k=4, num_slots=8):
     }
 
 
+def _prefill_peak_temp_bytes(model, maxlen, bucket, num_slots, kernel):
+    """Measured peak-memory proxy of ONE full-bucket prefill program:
+    XLA's own temp-buffer high-water mark (the largest set of live
+    intermediates — where the naive kernel's [B, H, S, S] score
+    matrices live) from compiling the program ahead-of-time with
+    abstract arguments. Nothing executes; this is the compiler's
+    allocation plan, not a heap sample."""
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.serving.kv_cache import prefill_forward
+    from elephas_tpu.models.transformer import _flash_mha_layer
+
+    SDS = jax.ShapeDtypeStruct
+    FlashMHA = _flash_mha_layer()
+    w = {
+        v.path: SDS(tuple(v.value.shape), jnp.float32)
+        for v in model.variables
+    }
+    caches = {
+        l.name: (
+            SDS((num_slots, maxlen, l.num_heads, l.head_dim),
+                jnp.float32),
+            SDS((num_slots, maxlen, l.num_heads, l.head_dim),
+                jnp.float32),
+        )
+        for l in model._flatten_layers() if isinstance(l, FlashMHA)
+    }
+    rows = SDS((num_slots, bucket), jnp.int32)
+    admit = SDS((num_slots,), jnp.bool_)
+
+    def run(w, rows, caches, admit):
+        return prefill_forward(
+            model, w, rows, caches, admit, maxlen, attention=kernel
+        )
+
+    compiled = jax.jit(run).lower(w, rows, caches, admit).compile()
+    return int(compiled.memory_analysis().temp_size_in_bytes)
+
+
+def _serving_flashprefill_section(rounds=5, num_slots=2, maxlen=512):
+    """Flash vs naive long-prompt prefill TTFT (ISSUE 11), GATED.
+
+    Workload: prompts at the LONGEST prompt bucket of a d128L4
+    stand-in with a real long context (maxlen 512 — the preset's
+    shared d128L4 stand-in stops at maxlen 128, where one 128-wide
+    tile covers the whole bucket and tiling can neither skip nor
+    shrink anything; the O(T²) term this section measures needs T
+    past one tile). Two engines differing ONLY in the attention
+    kernel, warmed to compile, then alternating rounds (the serving
+    honesty contract — a machine-regime shift hits both inside each
+    round); the median round is the figure.
+
+    Gates (JSON refused otherwise):
+    - flash TTFT >= 1.3x faster than naive at the longest bucket;
+    - closed compile set: re-running the identical workload adds NO
+      compiles on the flash engine.
+
+    Also reported: XLA's compiled temp-buffer high-water mark for the
+    longest-bucket prefill program under each kernel (the O(S²) score
+    matrix is the dominant naive intermediate; flash should shrink
+    it), and each engine's kernel label as recorded in compile_stats.
+    """
+    import numpy as np
+
+    from elephas_tpu.models import transformer_lm
+    from elephas_tpu.serving import InferenceEngine
+
+    vocab = 512
+    model = transformer_lm(
+        vocab_size=vocab, maxlen=maxlen, d_model=128, num_heads=4,
+        num_layers=4, dropout=0.0, seed=0,
+    )
+    rng = np.random.default_rng(0)
+    prompt_len = maxlen - 12  # longest bucket, room for the budget
+    workload = [
+        (rng.integers(1, vocab, size=prompt_len).astype(np.int32), 2)
+        for _ in range(num_slots)
+    ]
+
+    engines = {}
+    for kernel in ("flash", "naive"):
+        eng = InferenceEngine(
+            model, num_slots=num_slots, attention=kernel
+        )
+        eng.run(list(workload))  # warmup: compile prefill + decode
+        engines[kernel] = eng
+    compiles_before = engines["flash"].compile_stats()
+
+    per_round = []
+    for _r in range(rounds):
+        round_ttft = {}
+        for kernel, eng in engines.items():
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, mn) for p, mn in workload]
+            for _ in eng.stream():
+                pass
+            dt = time.perf_counter() - t0
+            if dt <= MIN_CREDIBLE_DT:
+                raise ImplausibleTiming(
+                    f"flashprefill {kernel} round {dt:.4f}s below the "
+                    f"{MIN_CREDIBLE_DT}s credibility floor"
+                )
+            round_ttft[kernel] = float(
+                np.mean([r.ttft for r in reqs])
+            )
+        per_round.append(round_ttft)
+
+    med = {
+        k: float(np.median([r[k] for r in per_round]))
+        for k in ("flash", "naive")
+    }
+    ratio = med["naive"] / med["flash"]
+    if ratio < 1.3:
+        raise ImplausibleTiming(
+            f"flashprefill gate: flash TTFT {med['flash']*1e3:.1f}ms "
+            f"vs naive {med['naive']*1e3:.1f}ms at the {prompt_len}-"
+            f"token bucket — {ratio:.2f}x, below the 1.3x acceptance "
+            f"bar; refusing to emit JSON"
+        )
+    compiles_after = engines["flash"].compile_stats()
+    if compiles_after != compiles_before:
+        raise ImplausibleTiming(
+            f"flashprefill gate: the timed rounds COMPILED — the "
+            f"compiled-shape set is not closed "
+            f"({compiles_before} -> {compiles_after}); refusing to "
+            f"emit JSON"
+        )
+    bucket = engines["flash"].scheduler.bucket_for(prompt_len)
+    peak = {
+        k: _prefill_peak_temp_bytes(model, maxlen, bucket, num_slots, k)
+        for k in ("flash", "naive")
+    }
+    for eng in engines.values():
+        eng.release_telemetry()
+    return {
+        "ttft_ms_flash": round(med["flash"] * 1e3, 2),
+        "ttft_ms_naive": round(med["naive"] * 1e3, 2),
+        "ttft_speedup": round(ratio, 3),
+        "ttft_ms_rounds": [
+            {k: round(v * 1e3, 2) for k, v in r.items()}
+            for r in per_round
+        ],
+        "prompt_tokens": prompt_len,
+        "bucket": bucket,
+        "maxlen": maxlen,
+        "prefill_peak_temp_bytes_flash": peak["flash"],
+        "prefill_peak_temp_bytes_naive": peak["naive"],
+        "peak_temp_reduction": round(
+            peak["naive"] / max(1, peak["flash"]), 2
+        ),
+        "decode_compiles": compiles_after["decode_compiles"],
+        "span_buckets": list(compiles_after["span_buckets"]),
+    }
+
+
 def _serving_telemetry_section(model, maxlen, vocab, num_slots,
                                rounds=5):
     """Telemetry-overhead check (ISSUE 5 satellite): the same workload
@@ -1577,6 +1733,21 @@ def measure_serving(n_requests: int, num_slots: int, backend: str,
     # race, and the dispatch-bound toy's sub-ms steps would let even
     # FIFO meet every deadline (no overload to measure)
     slo = _serving_slo_section(lat_model, maxlen, lat_vocab)
+    # flash vs naive long-prompt prefill (ISSUE 11): its own deeper
+    # stand-in (maxlen 512) — the shared d128L4 stand-in stops at one
+    # attention tile, where tiling has nothing to skip or shrink
+    flashprefill = _serving_flashprefill_section()
+    log.info(
+        "serving flashprefill (flash vs naive, %d-token prompts): "
+        "TTFT %.1fms vs %.1fms (%.2fx, >=1.3x required), prefill "
+        "peak temp bytes %s vs %s (%.1fx smaller)",
+        flashprefill["prompt_tokens"],
+        flashprefill["ttft_ms_flash"], flashprefill["ttft_ms_naive"],
+        flashprefill["ttft_speedup"],
+        flashprefill["prefill_peak_temp_bytes_flash"],
+        flashprefill["prefill_peak_temp_bytes_naive"],
+        flashprefill["peak_temp_reduction"],
+    )
     log.info(
         "serving slo (open-loop 2-tenant overload): goodput %d policy "
         "vs %d FIFO (%.2fx, >=1.5x required), light-tenant p99 TTFT "
@@ -1645,6 +1816,10 @@ def measure_serving(n_requests: int, num_slots: int, backend: str,
         "occupancy": round(mid["occupancy"], 3),
         "decode_compiles": compiles["decode_compiles"],
         "prefill_compiles": compiles["prefill_compiles"],
+        # the attention kernel the headline engine ran (ISSUE 11) —
+        # a speedup figure is meaningless without knowing which
+        # kernel produced it
+        "attention": compiles["attention"],
         "num_requests": n_requests,
         "num_slots": engine.num_slots,
         "steps_per_sync": engine.steps_per_sync,
@@ -1671,6 +1846,7 @@ def measure_serving(n_requests: int, num_slots: int, backend: str,
         "longctx": longctx,
         "specdec": specdec,
         "slo": slo,
+        "flashprefill": flashprefill,
     }
 
 

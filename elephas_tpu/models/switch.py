@@ -99,17 +99,28 @@ def _moe_ffn_layer():
                 1,
                 int(self.k * t * self.capacity_factor / self.num_experts),
             )
+            # read .value explicitly: raw keras Variables are not valid
+            # JAX types in jnp ops (jax dropped the __jax_array__
+            # auto-convert protocol), and under keras' StatelessScope —
+            # SparkModel training steps, the serving engine's graph
+            # replay — .value resolves to the scope's traced array, so
+            # autodiff and GSPMD shardings flow through unchanged.
+            # This was the root cause of the seed's 8 MoE/SP tier-1
+            # failures (regression-pinned in tests/test_moe.py).
+            gate_w = self.gate_kernel.value
+            w1, b1 = self.expert_w1.value, self.expert_b1.value
+            w2, b2 = self.expert_w2.value, self.expert_b2.value
             dispatch, combine, aux = _topk_dispatch(
-                tokens, self.gate_kernel, self.num_experts, capacity, k=self.k
+                tokens, gate_w, self.num_experts, capacity, k=self.k
             )
             expert_inputs = jnp.einsum("td,tec->ecd", tokens, dispatch)
             h = act(
-                jnp.einsum("ecd,edh->ech", expert_inputs, self.expert_w1)
-                + self.expert_b1[:, None, :]
+                jnp.einsum("ecd,edh->ech", expert_inputs, w1)
+                + b1[:, None, :]
             )
             out = (
-                jnp.einsum("ech,ehd->ecd", h, self.expert_w2)
-                + self.expert_b2[:, None, :]
+                jnp.einsum("ech,ehd->ecd", h, w2)
+                + b2[:, None, :]
             )
             out = jnp.einsum("ecd,tec->td", out, combine)
             if training:

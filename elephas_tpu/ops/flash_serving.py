@@ -1,0 +1,191 @@
+"""Tiled online-softmax attention for the serving hot path (ISSUE 11).
+
+The serving programs in :mod:`elephas_tpu.serving.kv_cache` and
+:mod:`elephas_tpu.serving.paged_kv` historically materialized the full
+``[B, H, C, S]`` score matrix per layer (``S`` = ``maxlen`` for the
+fixed arena, the table-bucket span for the paged pool) and softmaxed
+it — O(C·S) live memory per head and every K/V row of the span touched
+per query. This module is the FlashAttention-style replacement
+(Dao et al. 2022, the same construction ``ops/flash_attention.py``
+hand-tiles for the MXU): K/V stream through fixed-size tiles, the
+softmax runs online (running max ``m``, normalizer ``l``, accumulator
+``acc``), and the score matrix never exists beyond one ``[B, H, C,
+block_k]`` tile.
+
+Unlike the Pallas kernel (which interprets — slowly — off-TPU), these
+primitives are plain XLA: ``jnp`` einsums over statically sliced tiles,
+unrolled at trace time. They fuse into the serving programs' jit on any
+backend, the tile loop bounds are static (compiled shapes stay a closed
+set), and causal prefill SKIPS the strictly-future tiles statically —
+the O(T²)→O(T²/2) compute cut plus the O(T) memory cut are where the
+long-prompt TTFT win comes from.
+
+Numerics: online softmax evaluates the same mathematical softmax with a
+different association order, so outputs match the naive oracle to float
+tolerance, not bitwise. Temperature-0 tokens are argmax over logits
+whose perturbation is ~1e-6 of the logit scale — token streams stay
+exact on any model whose argmax is not a coin flip (the serving parity
+suites assert exactly this, and the naive kernel remains selectable as
+``attention="naive"``).
+
+Fully-masked query rows (inactive slot lanes, padded chunk tails)
+output exact zeros here, where the naive path produces NaN garbage —
+both are fine (those lanes are never read), but zeros keep debugging
+sane.
+"""
+
+from __future__ import annotations
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK = 128
+SPAN_FLOOR = 64
+
+
+def span_buckets(maxlen: int, floor: int = SPAN_FLOOR) -> tuple[int, ...]:
+    """Power-of-two attention-span ladder ``[floor, 2·floor, ..]``
+    capped at (and always including) ``maxlen`` — the fixed arena's
+    analogue of the paged table-bucket ladder: flash decode/chunk
+    programs compile once per span bucket and attend over
+    ``cache[:, :span]`` instead of the full ``maxlen`` row, so a
+    short-context steady state stops paying for the arena's worst case.
+    The floor keeps tiny models at ONE bucket (one decode compile, the
+    seed contract the serving tests pin)."""
+    if maxlen <= 0:
+        raise ValueError(f"maxlen must be positive, got {maxlen}")
+    buckets, b = [], max(1, int(floor))
+    while b < maxlen:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(maxlen))
+    return tuple(buckets)
+
+
+def span_bucket_for(n: int, buckets) -> int:
+    """Smallest span bucket covering ``n`` resident positions."""
+    for b in buckets:
+        if b >= n:
+            return int(b)
+    raise ValueError(
+        f"span of {n} positions exceeds the largest bucket "
+        f"{max(buckets)}"
+    )
+
+
+def _online_update(m, l, acc, s, vt):
+    """One online-softmax accumulation step: fold the masked score
+    tile ``s`` (``[..., bk]``, NEG_INF where invisible) and its value
+    tile ``vt`` into the ``(m, l, acc)`` running state. The ``p``
+    guard zeroes rows that have seen nothing but mask so far —
+    ``exp(NEG_INF - NEG_INF)`` would otherwise accumulate phantom
+    mass (same guard as the Pallas kernel)."""
+    import jax.numpy as jnp
+
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(m_new[..., None] <= NEG_INF * 0.5, 0.0, p)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + p.sum(axis=-1)
+    acc = acc * alpha[..., None] + jnp.einsum("bhck,bkhd->bhcd", p, vt)
+    return m_new, l, acc
+
+
+def flash_span_chunk(q, gk, gv, pos_mat, scale=None,
+                     block_k: int = DEFAULT_BLOCK):
+    """Tiled attention of chunk queries over a resident K/V span.
+
+    ``q``: ``[B, H, C, Dh]`` queries at absolute positions ``pos_mat``
+    (``[B, C]`` int32); ``gk``/``gv``: ``[B, S, H, Dh]`` — the cache
+    span (fixed-arena rows sliced to a span bucket, or a paged table
+    gather). Visibility is ``col <= pos`` (and ``col < S`` — callers
+    guarantee every visible position sits inside the span). Returns
+    ``[B, H, C, Dh]`` float32.
+
+    The K/V axis streams in ``block_k`` tiles under a static python
+    loop (``S`` is a bucketed compile-time constant, so the unroll is
+    bounded by the span ladder); ragged final tiles take their natural
+    smaller static shape — no padding pass. Peak intermediate is one
+    ``[B, H, C, block_k]`` tile instead of the naive ``[B, H, C, S]``.
+    """
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    B, H, C, Dh = q.shape
+    S = int(gk.shape[1])
+    if scale is None:
+        scale = Dh ** -0.5
+    q = q.astype(f32)
+    m = jnp.full((B, H, C), NEG_INF, f32)
+    l = jnp.zeros((B, H, C), f32)
+    acc = jnp.zeros((B, H, C, Dh), f32)
+    for j0 in range(0, S, block_k):
+        j1 = min(S, j0 + block_k)
+        kt = gk[:, j0:j1].astype(f32)  # [B, bk, H, Dh]
+        vt = gv[:, j0:j1].astype(f32)
+        s = jnp.einsum("bhcd,bkhd->bhck", q, kt) * scale
+        vis = (
+            jnp.arange(j0, j1)[None, None, None, :]
+            <= pos_mat[:, None, :, None]
+        )
+        s = jnp.where(vis, s, NEG_INF)
+        m, l, acc = _online_update(m, l, acc, s, vt)
+    return acc / jnp.where(l == 0.0, 1.0, l)[..., None]
+
+
+def flash_span_decode(q, gk, gv, positions, scale=None,
+                      block_k: int = DEFAULT_BLOCK):
+    """One-row decode attention over a K/V span: ``q`` ``[B, H, Dh]``
+    at per-slot ``positions`` ``[B]``, ``gk``/``gv`` ``[B, S, H, Dh]``.
+    Returns ``[B, H, Dh]`` float32. The single query row rides
+    :func:`flash_span_chunk` with ``C == 1`` — one attention variant
+    to keep correct, and the block-span read (``S`` = a span/table
+    bucket, not ``maxlen``) is where decode's win lives."""
+    out = flash_span_chunk(
+        q[:, :, None], gk, gv, positions[:, None], scale=scale,
+        block_k=block_k,
+    )
+    return out[:, :, 0]
+
+
+def flash_causal_prefill(q, k, v, scale=None,
+                         block_q: int = DEFAULT_BLOCK,
+                         block_k: int = DEFAULT_BLOCK):
+    """Causal self-attention of a whole prompt bucket from position 0:
+    ``q``/``k``/``v`` ``[B, H, S, Dh]``, returns ``[B, H, S, Dh]``
+    float32.
+
+    Both axes tile; a K/V tile strictly in a query tile's future
+    (``j0 >= i1``) is SKIPPED at trace time — the static causal
+    schedule computes ~half the naive FLOPs, and only the
+    diagonal-crossing tile pays a mask at all. This is the program
+    behind cold full-bucket prefill, where the O(S²) term actually
+    bites."""
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    B, H, S, Dh = q.shape
+    if scale is None:
+        scale = Dh ** -0.5
+    q = q.astype(f32)
+    out = []
+    for i0 in range(0, S, block_q):
+        i1 = min(S, i0 + block_q)
+        qt = q[:, :, i0:i1]  # [B, H, bq, Dh]
+        bq = i1 - i0
+        m = jnp.full((B, H, bq), NEG_INF, f32)
+        l = jnp.zeros((B, H, bq), f32)
+        acc = jnp.zeros((B, H, bq, Dh), f32)
+        for j0 in range(0, i1, block_k):  # j0 >= i1 is wholly future
+            j1 = min(S, j0 + block_k)
+            kt = jnp.moveaxis(k[:, :, j0:j1], 1, 2).astype(f32)
+            vt = jnp.moveaxis(v[:, :, j0:j1], 1, 2).astype(f32)
+            s = jnp.einsum("bhcd,bkhd->bhck", qt, kt) * scale
+            if j1 > i0:  # diagonal-crossing tile: mask the future half
+                visible = (
+                    jnp.arange(j0, j1)[None, :]
+                    <= jnp.arange(i0, i1)[:, None]
+                )
+                s = jnp.where(visible[None, None], s, NEG_INF)
+            m, l, acc = _online_update(m, l, acc, s, vt)
+        out.append(acc / jnp.where(l == 0.0, 1.0, l)[..., None])
+    return jnp.concatenate(out, axis=2)

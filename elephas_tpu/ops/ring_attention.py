@@ -42,9 +42,18 @@ def _merge(o1, lse1, o2, lse2):
 
 
 def _ring_forward(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
-    """Returns (out, lse) for the local shard; kv chunks rotate the ring."""
+    """Returns (out, lse) for the local shard; kv chunks rotate the ring.
+
+    ``axis_index`` is taken ONLY on the causal path (where the switch
+    consumes it): a dead ``axis_index`` in the non-causal scan body
+    survives DCE into the lowered module, and XLA's SPMD partitioner
+    refuses the orphaned ``PartitionId`` outside a manual region
+    ("PartitionId instruction is not supported for SPMD
+    partitioning...") — the root cause of the seed's non-causal
+    SP failures (jit'd evaluate/predict under a sequence scope;
+    regression-pinned in tests/test_sequence_parallel.py)."""
     w = axis_size_compat(axis_name)
-    me = jax.lax.axis_index(axis_name)
+    me = jax.lax.axis_index(axis_name) if causal else None
     bh, s_local, d = q.shape
     f32 = jnp.float32
 
@@ -72,8 +81,8 @@ def _ring_forward(q, k, v, axis_name, causal, scale, block_q, block_k, interpret
 
     def step(carry, t):
         o, lse, kc, vc = carry
-        src = (me - t) % w
         if causal:
+            src = (me - t) % w
             case = jnp.where(src == me, 1, jnp.where(src > me, 2, 0))
             oc, lsec = jax.lax.switch(
                 case, (full_chunk, diag_chunk, skip_chunk), q, kc, vc
@@ -122,7 +131,9 @@ def _ring_backward(axis_name, causal, scale, block_q, block_k, interpret,
                    residuals, g):
     q, k, v, out, lse = residuals
     w = axis_size_compat(axis_name)
-    me = jax.lax.axis_index(axis_name)
+    # causal-only, as in _ring_forward: a dead axis_index in the
+    # non-causal body lowers to an orphaned PartitionId (see there)
+    me = jax.lax.axis_index(axis_name) if causal else None
     bh, s_local, d = q.shape
     f32 = jnp.float32
     delta = jnp.sum(g.astype(f32) * out.astype(f32), axis=-1)  # [bh, S_local]
@@ -134,8 +145,8 @@ def _ring_backward(axis_name, causal, scale, block_q, block_k, interpret,
 
     def step(carry, t):
         dq, dk_rot, dv_rot, kc, vc = carry
-        src = (me - t) % w
         if causal:
+            src = (me - t) % w
             # global positions: my rows at me*S, chunk cols at src*S
             mask = (rows + me * s_local) >= (cols + src * s_local)
         else:

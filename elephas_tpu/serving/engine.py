@@ -40,6 +40,7 @@ import time
 import numpy as np
 
 from elephas_tpu import telemetry
+from elephas_tpu.ops.flash_serving import span_bucket_for, span_buckets
 from elephas_tpu.serving.blocks import BlockAllocator
 from elephas_tpu.serving.kv_cache import (
     SlotKVCache,
@@ -171,6 +172,26 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
     reorders and rejects — it NEVER touches decoding, so temperature-0
     token streams stay bit-exact per request under any policy.
 
+    ``attention="flash"`` (ISSUE 11, the default) runs every serving
+    program's attention core through the tiled online-softmax kernel
+    (:mod:`elephas_tpu.ops.flash_serving`): full-bucket prefill skips
+    strictly-future tiles statically, chunk/verify stream the arena
+    row in tiles, and the fixed arena's decode/chunk attend over a
+    SPAN BUCKET covering the live residents instead of ``maxlen``
+    (compiled per touched bucket — a closed ladder). ``"naive"``
+    selects the seed full-materialized path, kept as the bitwise
+    parity oracle. Flash logits match naive to float tolerance;
+    temperature-0 token streams are exact (see docs/API.md).
+
+    ``sp_prefill=`` (ISSUE 11, paged + unmeshed engines) arms
+    sequence-parallel long-prompt prefill: a cold prompt of at least
+    ``sp_threshold`` tokens (default ``maxlen // 2``) runs ONE
+    ring/Ulysses-sharded forward over the given mesh's ``sp_axis``,
+    lands its K/V straight into the slot's reserved pool blocks, and
+    decodes unmeshed — removing the single-device ceiling on prompt
+    ingestion (``sp_mechanism="ring"`` has no head-count constraint;
+    ``"ulysses"`` needs ``num_heads % axis_size == 0``).
+
     PP ring decode is not integrated yet — construct via
     ``SparkModel.serve()`` on a DP/TP mesh, or directly on no mesh.
     """
@@ -190,7 +211,12 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                  speculative: bool = False,
                  spec_k: int | None = None,
                  spec_drafter=None,
-                 policy=None):
+                 policy=None,
+                 attention: str = "flash",
+                 sp_prefill=None,
+                 sp_axis: str = "seq",
+                 sp_threshold: int | None = None,
+                 sp_mechanism: str = "ring"):
         import jax
         import jax.numpy as jnp
 
@@ -336,6 +362,99 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                     f"spec_k={k} outside [1, maxlen={self.maxlen})"
                 )
             self.spec_k = k
+
+        # -- attention kernel selection (ISSUE 11) ---------------------
+        # "flash" (default) = tiled online-softmax serving programs
+        # (ops/flash_serving): O(span) score memory, static causal tile
+        # skipping in full-bucket prefill, span-bucketed block-span
+        # reads in fixed-arena decode/chunk. "naive" = the seed
+        # full-materialized einsum/softmax path, kept selectable as the
+        # bitwise parity oracle. Flash output matches naive to float
+        # tolerance and temp-0 token streams exactly (documented in
+        # docs/API.md "Attention kernels").
+        if attention not in ("flash", "naive"):
+            raise ValueError(
+                f"attention must be 'flash' or 'naive', got "
+                f"{attention!r}"
+            )
+        self.attention = attention
+        # fixed-arena span ladder: flash decode/chunk/verify programs
+        # attend over cache[:, :span] for a bucketed span covering the
+        # live residents — compiled once per touched bucket (a closed
+        # set; the floor keeps small models at ONE decode compile)
+        self._sbuckets = span_buckets(self.maxlen)
+
+        # -- sequence-parallel long-prompt prefill (ISSUE 11) ----------
+        if sp_prefill is not None:
+            if not self.paged:
+                raise ValueError(
+                    "sp_prefill requires paged=True — the SP prefill "
+                    "lands K/V into the block pool (the fixed arena "
+                    "has no block-granular landing path)"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "sp_prefill requires an UNMESHED engine — the SP "
+                    "mesh serves prefill only, and decode proceeds "
+                    "unmeshed on the landed blocks (a decode mesh "
+                    "would double-shard the pool)"
+                )
+            if sp_mechanism not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"sp_mechanism must be 'ring' or 'ulysses', got "
+                    f"{sp_mechanism!r}"
+                )
+            if sp_axis not in sp_prefill.shape:
+                raise ValueError(
+                    f"sp_axis {sp_axis!r} not in the SP mesh axes "
+                    f"{tuple(sp_prefill.shape)}"
+                )
+            sp_w = int(sp_prefill.shape[sp_axis])
+            if sp_w & (sp_w - 1):
+                # pad lengths are powers of two (sp_pad_len), and a
+                # non-power-of-two shard count divides none of them —
+                # the shard_map would raise mid-serve on the first
+                # long prompt; fail HERE instead
+                raise ValueError(
+                    f"sp_prefill axis {sp_axis!r} has size {sp_w} — "
+                    f"SP prefill pads prompts to power-of-two "
+                    f"lengths, which only tile over a power-of-two "
+                    f"shard count; reshape the mesh"
+                )
+            if sp_mechanism == "ulysses":
+                bad = [
+                    (name, h) for name, h, _d in (
+                        (l.name, int(l.num_heads), int(l.head_dim))
+                        for l in flash_layers
+                    ) if h % sp_w
+                ]
+                if bad:
+                    raise ValueError(
+                        f"ulysses SP prefill needs num_heads divisible "
+                        f"by the seq axis size ({sp_w}); offending "
+                        f"layers: {bad} — use sp_mechanism='ring'"
+                    )
+            if sp_threshold is not None and int(sp_threshold) < 1:
+                raise ValueError(
+                    f"sp_threshold={sp_threshold} < 1"
+                )
+        elif sp_threshold is not None or sp_axis != "seq" \
+                or sp_mechanism != "ring":
+            raise ValueError(
+                "sp_threshold/sp_axis/sp_mechanism require sp_prefill= "
+                "(an SP mesh) — silently ignoring them would misreport "
+                "how long prompts prefill"
+            )
+        self.sp_mesh = sp_prefill
+        self.sp_axis = sp_axis
+        self.sp_mechanism = sp_mechanism
+        # prompts at or above the threshold prefill over the SP mesh;
+        # default: half the model's context (the regime where a single
+        # device's prefill dominates TTFT)
+        self.sp_threshold = (
+            int(sp_threshold) if sp_threshold is not None
+            else max(1, self.maxlen // 2)
+        ) if sp_prefill is not None else None
 
         # -- SLO admission policy (ISSUE 10) ---------------------------
         if policy is not None and not isinstance(policy, Policy):
@@ -549,6 +668,28 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 ):
                     fam.labels(engine=eid, tenant=t)
 
+        # attention-kernel info gauge (ISSUE 11): the kernel rides as a
+        # LABEL (value is a constant 1) so dashboards can join "which
+        # kernel is this engine on" against any of its other series
+        treg.gauge(
+            "elephas_serving_attn_kernel",
+            "Attention kernel the serving programs run (info gauge: "
+            "constant 1, kernel name in the label)",
+            labels=("engine", "kernel"),
+        ).labels(engine=eid, kernel=self.attention).set(1)
+        # per-bucket prefill-token histogram (ISSUE 11): one observation
+        # per completed prefill, labeled by the compiled bucket it ran
+        # through — Chrome traces say WHERE long prompts spend TTFT,
+        # this says how often each bucket is actually exercised
+        self._mf_prefill_tokens = treg.histogram(
+            "elephas_serving_prefill_tokens",
+            "Prompt tokens ingested per completed prefill, by prompt "
+            "size class (the prompt-bucket ladder; sp<S> = sequence-"
+            "parallel padded length). NOTE: chunked/paged prefills "
+            "compile per chunk width, not per prompt bucket — this "
+            "label classifies the PROMPT, not the program.",
+            labels=("engine", "bucket"),
+        )
         treg.gauge(
             "elephas_serving_slots", "KV-cache slots in the arena",
             labels=("engine",),
@@ -593,10 +734,13 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             temps = _vec(jnp.zeros((self.num_slots,), jnp.float32))
             return caches, lengths, last, temps
 
+        attn_kernel = self.attention
+
         def prefill(w, caches, lengths, last, temps, tokens_rows,
                     p_lens, admit, new_temps, key):
             logits, caches = prefill_forward(
-                model, w, tokens_rows, caches, admit, maxlen
+                model, w, tokens_rows, caches, admit, maxlen,
+                attention=attn_kernel,
             )
             caches = _constrain_all(caches)
             # each row's next-token logits sit at its own prompt end —
@@ -631,17 +775,20 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         k_window = max(1, int(steps_per_sync))
         self.steps_per_sync = k_window
 
-        def decode(w, caches, lengths, last, temps, active, key):
+        def decode(w, caches, lengths, last, temps, active, key,
+                   span=None):
             # `active` masks idle / mid-chunked-prefill / prefix-donor
             # slots OUT of the cache write and cursor advance — their
             # resident rows must survive the window; active slots' math
-            # is untouched (bit-identical to the unmasked program)
+            # is untouched (bit-identical to the unmasked program).
+            # `span` (STATIC, flash mode): the attended row slice — a
+            # span bucket covering every live resident + the window.
             def body(i, carry):
                 caches, lengths, last, key, toks = carry
                 positions = jnp.minimum(lengths, maxlen - 1)
                 logits, caches = token_decode_step(
                     model, w, last, positions, caches, maxlen,
-                    active=active,
+                    active=active, attention=attn_kernel, span=span,
                 )
                 caches = _constrain_all(caches)
                 key, sub = jax.random.split(key)
@@ -664,7 +811,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         def chunk_step(w, caches, lengths, last, temps, tokens, offs,
                        clens, act, fin, p_lens, new_temps,
                        src_idx, copy_mask, copy_len, key,
-                       has_copy: bool):
+                       has_copy: bool, span=None):
             """One bounded prefill chunk for every slot in ``act`` —
             cold chunked prefill and post-copy suffix prefill alike.
             Slots in ``fin`` end their prompt inside this chunk: their
@@ -692,7 +839,8 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                     caches, src_idx, copy_mask, copy_len, maxlen
                 ))
             logits, caches = chunked_prefill_forward(
-                model, w, tokens, caches, offs, clens, act, maxlen
+                model, w, tokens, caches, offs, clens, act, maxlen,
+                attention=attn_kernel, span=span,
             )
             caches = _constrain_all(caches)
             C = tokens.shape[1]
@@ -728,7 +876,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 logits, caches = paged_token_decode_step(
                     model, w, last, positions, caches, tables,
                     self.block_size, maxlen, active,
-                    local=mesh is None,
+                    local=mesh is None, attention=attn_kernel,
                 )
                 caches = _constrain_all(caches)
                 key, sub = jax.random.split(key)
@@ -758,6 +906,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             logits, caches = paged_chunk_forward(
                 model, w, tokens, caches, tables, offs, clens, act,
                 self.block_size, maxlen, local=mesh is None,
+                attention=attn_kernel,
             )
             caches = _constrain_all(caches)
             C = tokens.shape[1]
@@ -824,10 +973,11 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             ).reshape(B, C)
             return key, sampled
 
-        def spec_verify(w, caches, packed, temps, key):
+        def spec_verify(w, caches, packed, temps, key, span=None):
             tokens, offs, n_fed, act = _unpack_verify(packed)
             logits, caches = verify_forward(
-                model, w, tokens, caches, offs, n_fed, act, maxlen
+                model, w, tokens, caches, offs, n_fed, act, maxlen,
+                attention=attn_kernel, span=span,
             )
             caches = _constrain_all(caches)
             key, sampled = _sample_window(logits, temps, key)
@@ -838,10 +988,58 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             logits, caches = paged_verify_forward(
                 model, w, tokens, caches, tables, offs, n_fed, act,
                 self.block_size, maxlen, local=mesh is None,
+                attention=attn_kernel,
             )
             caches = _constrain_all(caches)
             key, sampled = _sample_window(logits, temps, key)
             return caches, key, sampled
+
+        # -- SP long-prompt prefill program (ISSUE 11): one whole-
+        # prompt forward over the SP mesh returning logits AND every
+        # layer's K/V rows, landed straight into the block pool via
+        # the same scatter program resume uses, plus the first-token
+        # sample — ONE dispatch per long prompt. Compiled per (padded
+        # length, table bucket) pair, both closed ladders.
+        if self.sp_mesh is not None:
+            from elephas_tpu.serving.sp_prefill import sp_prefill_forward
+
+            sp_mesh_, sp_ax_, sp_mech_ = (
+                self.sp_mesh, self.sp_axis, self.sp_mechanism
+            )
+
+            def sp_step(w, tokens, p_idx):
+                """Mesh half of the SP prefill: the sharded forward
+                only. K/V rows and the prompt-end logits row hop back
+                to the default device on the host side; sampling and
+                the block landing run UNMESHED (the scatter program
+                resume already owns) — nothing mesh-committed ever
+                touches the pool or the key stream, so decode stays
+                unmeshed ("proceeds unmeshed" is the contract) and no
+                downstream program recompiles."""
+                logits, kv = sp_prefill_forward(
+                    model, w, tokens, sp_mesh_, sp_ax_, sp_mech_,
+                    maxlen,
+                )
+                row = jax.lax.dynamic_index_in_dim(
+                    logits[0], p_idx - 1, axis=0, keepdims=False
+                )
+                return kv, row
+
+            def sp_sample(row, temp, key):
+                key, sub = jax.random.split(key)
+                tok = _sample_dynamic(
+                    row[None], sub, temp, self.top_k, self.top_p
+                )[0]
+                return tok, key
+
+            self._sp_jit = jax.jit(sp_step)
+            self._sp_sample_jit = jax.jit(sp_sample)
+        else:
+            self._sp_jit = None
+            self._sp_sample_jit = None
+        # SP weight staging (mesh-replicated) built lazily on the
+        # first long prompt; refresh_weights() drops it
+        self._sp_weights = None
 
         # the fixed program set: ONE decode window + one prefill per
         # prompt bucket (p_lens/admit/new_temps ride as traced vectors,
@@ -879,19 +1077,26 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             )  # args: w, caches, lengths, last, temps, rows, p_lens,
             #         admit, new_temps, key
             self._decode_jit = jax.jit(
-                decode, donate_argnums=(1, 2, 3, 6)
-            )
+                decode, donate_argnums=(1, 2, 3, 6),
+                static_argnums=(7,),
+            )  # trailing STATIC span (flash block-span reads): one
+            #   compile per touched span bucket — naive always passes
+            #   None, keeping the seed's single decode program
             self._chunk_jit = jax.jit(
                 chunk_step, donate_argnums=(1, 2, 3, 4, 15),
-                static_argnums=(16,),
+                static_argnums=(16, 17),
             )  # args: w, caches, lengths, last, temps, tokens, offs,
             #         clens, act, fin, p_lens, new_temps, src_idx,
-            #         copy_mask, copy_len, key, has_copy (static)
+            #         copy_mask, copy_len, key, has_copy (static),
+            #         span (static)
             self._copy_jit = jax.jit(copy_prefix, donate_argnums=(0,))
             self._verify_jit = (
-                jax.jit(spec_verify, donate_argnums=(1, 4))
+                jax.jit(
+                    spec_verify, donate_argnums=(1, 4),
+                    static_argnums=(5,),
+                )
                 if self.speculative else None
-            )  # args: w, caches, packed, temps, key
+            )  # args: w, caches, packed, temps, key, span (static)
 
         self.refresh_weights()
         self._caches, self._lengths, self._last, self._temps = (
@@ -1004,6 +1209,9 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         drafter = getattr(self, "_drafter", None)
         if drafter is not None:
             drafter.refresh_weights()
+        # SP prefill keeps its own mesh-replicated weight staging —
+        # drop it so the next long prompt re-stages the new weights
+        self._sp_weights = None
 
         if self.mesh is None:
             self._weights = {
@@ -1272,6 +1480,27 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                     self._finished_bound, victim, evicted,
                 )
 
+    def _fixed_span(self, max_pos_excl: int):
+        """Static attended-span bucket for the fixed arena's flash
+        programs: the smallest span bucket covering ``max_pos_excl``
+        resident positions. ``None`` in naive mode (the seed
+        full-``maxlen`` program) and for the paged arena (its span is
+        the table bucket already)."""
+        if self.attention != "flash" or self.paged:
+            return None
+        n = max(1, min(self.maxlen, int(max_pos_excl)))
+        return span_bucket_for(n, self._sbuckets)
+
+    def _decode_span(self):
+        """Span bucket for one decode window: every decoding slot's
+        resident length plus the window's worth of new positions."""
+        m = 0
+        for slot, req in self.scheduler.active.items():
+            if slot in self._prefilling:
+                continue
+            m = max(m, len(req.prompt) + len(req.tokens) - 1)
+        return self._fixed_span(m + self.steps_per_sync)
+
     def _set_active(self, slot: int, value: bool) -> None:
         if bool(self._active_host[slot]) != value:
             self._active_host[slot] = value
@@ -1335,6 +1564,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 # reclaim only retains slots the cache already knows)
                 self.scheduler.on_prefill_complete(req)
                 self._set_active(req.slot, True)
+                self._note_prefill(req, bucket)
                 self._emit(req, int(toks[req.slot]))
 
     def _copy_vectors(self, copies):
@@ -1401,6 +1631,12 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 self._stage_slots(new_temps), self._key,
             )
         else:
+            # flash block-span read: the attended row slice need only
+            # cover this call's deepest written position (queries see
+            # the prefix copy + earlier chunks, all below it)
+            span = self._fixed_span(
+                max(progress + take for _a, progress, take in items)
+            ) if items else None
             (self._caches, self._lengths, self._last, self._temps,
              self._key, firsts) = self._chunk_jit(
                 self._weights, self._caches, self._lengths, self._last,
@@ -1409,7 +1645,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 self._stage_slots(act), self._stage_slots(fin),
                 self._stage_slots(p_lens), self._stage_slots(new_temps),
                 self._stage_slots(src), self._stage_slots(cmask),
-                self._stage_slots(clen), self._key, bool(copies),
+                self._stage_slots(clen), self._key, bool(copies), span,
             )
         emitted = []
         if finalized:
@@ -1424,6 +1660,9 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 else:
                     self.scheduler.on_prefill_complete(req)
                 self._set_active(adm.slot, True)
+                self._note_prefill(
+                    req, self.scheduler.bucket_for(len(req.prompt))
+                )
                 self._emit(req, int(toks[adm.slot]))
                 emitted.append((req, req.tokens[-1], req.done))
         return emitted
@@ -1536,18 +1775,164 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "cursor %d)", req.rid, adm.slot, n, store.cur_len,
         )
 
+    def _sp_eligible(self, a: Admission) -> bool:
+        """Does this fresh admission take the sequence-parallel prefill
+        path? Long cold prompts only — a prefix hit's shared blocks
+        already paid most of the prefill, and the SP pad length must
+        fit the model (else fall back, LOUDLY: silence here would hide
+        that the knob the caller reached for is not engaging)."""
+        if self.sp_mesh is None or a.shared_len:
+            return False
+        p = len(a.req.prompt)
+        if p < self.sp_threshold:
+            return False
+        from elephas_tpu.serving.sp_prefill import sp_pad_len
+
+        S = sp_pad_len(p, self.sp_mesh.shape[self.sp_axis], self.maxlen)
+        if S is None:
+            logger.warning(
+                "sp_prefill: prompt of %d tokens has no power-of-two "
+                "pad length inside maxlen=%d — falling back to the "
+                "single-device prefill path for request %d",
+                p, self.maxlen, a.req.rid,
+            )
+            return False
+        return True
+
+    def _sp_staged_weights(self):
+        """The engine's weights replicated over the SP mesh (lazy,
+        dropped by :meth:`refresh_weights`): engine weights may be
+        COMMITTED to the default device (e.g. values assigned off a
+        training mesh), and a committed single-device argument refuses
+        to enter a program whose shard_map spans the SP mesh."""
+        if self._sp_weights is None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.sp_mesh, P())
+            self._sp_weights = {
+                path: jax.device_put(w, rep)
+                for path, w in self._weights.items()
+            }
+        return self._sp_weights
+
+    def _sp_prefill(self, a: Admission):
+        """Prefill one long prompt over the SP mesh: ONE sharded
+        forward computes every position's K/V and logits, the rows
+        land in the slot's reserved blocks via the resume scatter, and
+        the first token samples from the prompt-end logits row. Decode
+        then proceeds unmeshed, indistinguishable from a chunk-prefilled
+        slot (token-exact at temperature 0)."""
+        import jax.numpy as jnp
+
+        from elephas_tpu.serving.sp_prefill import sp_pad_len
+
+        req = a.req
+        p = len(req.prompt)
+        sp_w = self.sp_mesh.shape[self.sp_axis]
+        S = sp_pad_len(p, sp_w, self.maxlen)
+        tokens = np.zeros((1, S), np.int32)
+        tokens[0, :p] = req.prompt
+        n_res = blocks_for(p, self.block_size)
+        ids = self._pad_ids(a.blocks[:n_res])
+        Tb = len(ids)
+        bs = self.block_size
+        with self._tracer.span(
+            "serve.sp_prefill", req=req.rid, prompt=p, padded=S,
+            shards=int(sp_w), mechanism=self.sp_mechanism,
+        ):
+            kv, row = self._sp_jit(
+                self._sp_staged_weights(), jnp.asarray(tokens),
+                np.int32(p),
+            )
+            # hop the K/V rows home through HOST memory (exactly how
+            # preemption-resume stages its rows) and land them through
+            # the UNMESHED scatter program — see sp_step's docstring.
+            # The hop must NOT use device_put: that returns COMMITTED
+            # arrays, committedness is part of jit cache keys, and one
+            # committed leaf reaching the pool recompiles every
+            # downstream program on its next dispatch.
+            span = Tb * bs
+            rows = {}
+            for name, (kr, vr) in kv.items():
+                hk = np.asarray(kr)
+                hv = np.asarray(vr)
+                if span <= S:
+                    hk, hv = hk[:span], hv[:span]
+                else:
+                    pad = ((0, span - S), (0, 0), (0, 0))
+                    hk = np.pad(hk, pad)
+                    hv = np.pad(hv, pad)
+                # sentinel-padded ids drop the bucketed tail; garbage
+                # rows past the prompt land inside the request's OWN
+                # reservation, where rewrite-before-visible covers them
+                rows[name] = (
+                    self._stage(hk.reshape(Tb, bs, *hk.shape[1:])),
+                    self._stage(hv.reshape(Tb, bs, *hv.shape[1:])),
+                )
+            self._caches = self._scatter_jit(
+                self._caches, self._stage(ids), rows
+            )
+            tok_dev, self._key = self._sp_sample_jit(
+                self._stage(np.asarray(row)),
+                jnp.full((1,), req.temperature, jnp.float32),
+                self._key,
+            )
+            tok = int(np.asarray(tok_dev))
+            mask = np.zeros((self.num_slots,), bool)
+            mask[a.slot] = True
+            r_len = np.zeros((self.num_slots,), np.int32)
+            r_len[a.slot] = p
+            r_last = np.zeros((self.num_slots,), np.int32)
+            r_last[a.slot] = tok
+            r_temps = np.zeros((self.num_slots,), np.float32)
+            r_temps[a.slot] = req.temperature
+            self._lengths, self._last, self._temps = (
+                self._resume_state_jit(
+                    self._lengths, self._last, self._temps,
+                    self._stage_slots(mask), self._stage_slots(r_len),
+                    self._stage_slots(r_last),
+                    self._stage_slots(r_temps),
+                )
+            )
+        self.scheduler.on_prefill_complete(req)
+        self._set_active(a.slot, True)
+        self._note_prefill(req, f"sp{S}")
+        self._emit(req, tok)
+        return [(req, req.tokens[-1], req.done)]
+
+    def _note_prefill(self, req: Request, bucket) -> None:
+        """One histogram observation per completed prefill, labeled by
+        the prompt's SIZE CLASS — the prompt-bucket ladder entry
+        covering it, or ``sp<S>`` for an SP prefill (ISSUE 11
+        telemetry). Chunked/paged prefills compile per chunk width,
+        so this classifies the prompt, not the compiled program."""
+        self._mf_prefill_tokens.labels(
+            engine=self.telemetry_label, bucket=str(bucket)
+        ).observe(len(req.prompt))
+
     def _admit_wave_paged(self, plan: list[Admission]):
         """Execute one paged admission wave: resumes restore their
         offloaded state (no prefill), fresh admissions prefill their
         un-shared suffix through the paged chunk program — whole
         suffix in one bucketed-width call, or budgeted chunks under
         ``prefill_chunk``. Prefix hits need NO device copy: the shared
-        blocks already sit in the slot's table."""
+        blocks already sit in the slot's table. Long cold prompts take
+        the sequence-parallel path when ``sp_prefill`` is armed
+        (:meth:`_sp_prefill`) — chunk budgets do not apply to them
+        (the SP dispatch IS the bounded unit of work)."""
         emitted: list[tuple[Request, int, bool]] = []
         for a in plan:
             if a.resume is not None:
                 self._resume(a)
-        fresh = [a for a in plan if a.resume is None]
+        fresh = []
+        for a in plan:
+            if a.resume is not None:
+                continue
+            if self._sp_eligible(a):
+                emitted.extend(self._sp_prefill(a))
+            else:
+                fresh.append(a)
         if self.prefill_chunk:
             for a in fresh:
                 self._prefilling[a.slot] = [a, a.shared_len]
@@ -1727,7 +2112,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                  window) = self._decode_jit(
                     self._weights, self._caches, self._lengths,
                     self._last, self._temps, self._sync_active(),
-                    self._key,
+                    self._key, self._decode_span(),
                 )
             toks = self._host(window)  # [steps_per_sync, num_slots]
             for i in range(self.steps_per_sync):
@@ -1841,9 +2226,16 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                     self._stage_slots(packed), self._temps, self._key,
                 )
             else:
+                # attended span covers the window's deepest write:
+                # offset + n_fed over the verifying slots
+                att_span = self._fixed_span(max(
+                    int(packed[s, W]) + int(packed[s, W + 1])
+                    for s, _r, _d in verifying
+                )) if verifying else None
                 self._caches, self._key, sampled = self._verify_jit(
                     self._weights, self._caches,
                     self._stage_slots(packed), self._temps, self._key,
+                    att_span,
                 )
             toks = self._host(sampled)  # [num_slots, W]
             self.scheduler.note_step()
@@ -1984,12 +2376,16 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 "verify_compiles": (
                     n(self._verify_jit) if self.speculative else 0
                 ),
+                "sp_prefill_compiles": (
+                    n(self._sp_jit) if self._sp_jit is not None else 0
+                ),
                 "buckets": tuple(self.scheduler.buckets),
                 "table_buckets": tuple(self._tbuckets),
                 "prefill_chunk": self.prefill_chunk,
                 "block_size": self.block_size,
                 "num_blocks": self.num_blocks,
                 "spec_k": self.spec_k,
+                "attention": self.attention,
             }
         return {
             "decode_compiles": n(self._decode_jit),
@@ -2000,8 +2396,13 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 n(self._verify_jit) if self.speculative else 0
             ),
             "buckets": tuple(self.scheduler.buckets),
+            # flash block-span reads compile per touched span bucket
+            # (closed ladder); naive never leaves the maxlen span, so
+            # its decode stays the seed's single program
+            "span_buckets": tuple(self._sbuckets),
             "prefill_chunk": self.prefill_chunk,
             "spec_k": self.spec_k,
+            "attention": self.attention,
         }
 
     def _tenant_stats(self) -> dict:
@@ -2078,6 +2479,10 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         accepted = int(self._m_spec_accepted.value)
         out = {
             "total_generated": self.total_generated,
+            # which attention kernel the programs run (ISSUE 11) —
+            # same truth the elephas_serving_attn_kernel info gauge
+            # labels, so dashboards and stats() can never disagree
+            "attention": self.attention,
             "decode_steps": self.scheduler._steps,
             "occupancy": self.scheduler.occupancy,
             "latencies": lat,

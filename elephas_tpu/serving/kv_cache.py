@@ -271,7 +271,7 @@ class SlotKVCache:
 
 
 def token_decode_step(model, w, tok, positions, caches, maxlen,
-                      active=None):
+                      active=None, attention="naive", span=None):
     """One decode step for the WHOLE arena: slot ``i`` consumes token
     ``tok[i]`` at position ``positions[i]`` (its write cursor), writes
     that position's K/V into its arena row, attends over positions
@@ -288,9 +288,23 @@ def token_decode_step(model, w, tok, positions, caches, maxlen,
     the rest of the arena decodes (ISSUE 4). Active slots' math is
     untouched — bit-identical with or without the mask.
 
+    ``attention``/``span`` (ISSUE 11): ``attention="flash"`` routes the
+    score/softmax through the tiled online-softmax kernel
+    (:mod:`elephas_tpu.ops.flash_serving` — float-tolerance parity,
+    temp-0 token-exact); ``span`` (a STATIC span bucket, ``None`` =
+    ``maxlen``) slices the attended K/V to ``cache[:, :span]`` — the
+    fixed arena's block-span read. Every ``positions[b]`` of an active
+    slot must sit inside the span (the engine buckets
+    ``max_resident + steps_per_sync``); an inactive lane's stale cursor
+    past the span just computes masked garbage nobody reads.
+
     Returns ``(logits [num_slots, vocab], new_caches)``."""
     import jax
     import jax.numpy as jnp
+
+    from elephas_tpu.ops.flash_serving import flash_span_decode
+
+    S_att = int(maxlen if span is None else span)
 
     ctx_new = {}
     # write cursor as a one-hot over the sequence axis: the cache write
@@ -323,17 +337,27 @@ def token_decode_step(model, w, tok, positions, caches, maxlen,
                 k = _apply_rope(k, cos_t, sin_t)
             ck = jnp.where(write_mask, k[:, None], ck)
             cv = jnp.where(write_mask, v[:, None], cv)
-            att = jnp.einsum("bhd,bshd->bhs", q, ck) * (Dh**-0.5)
-            visible = (
-                jnp.arange(maxlen)[None, None, :]
-                <= positions[:, None, None]
-            )
-            att = jax.nn.softmax(
-                jnp.where(visible, att, -jnp.inf), axis=-1
-            )
-            o = jnp.einsum("bhs,bshd->bhd", att, cv).reshape(
-                x.shape[0], H * Dh
-            )
+            if attention == "flash":
+                o = flash_span_decode(
+                    q, ck[:, :S_att], cv[:, :S_att], positions,
+                    scale=Dh**-0.5,
+                ).reshape(x.shape[0], H * Dh)
+            else:
+                # flash-lint: allow — the selectable naive oracle
+                att = jnp.einsum(
+                    "bhd,bshd->bhs", q, ck[:, :S_att]
+                ) * (Dh**-0.5)
+                visible = (
+                    jnp.arange(S_att)[None, None, :]
+                    <= positions[:, None, None]
+                )
+                att = jax.nn.softmax(
+                    jnp.where(visible, att, -jnp.inf), axis=-1
+                )
+                # flash-lint: allow — naive oracle att@V
+                o = jnp.einsum(
+                    "bhs,bshd->bhd", att, cv[:, :S_att]
+                ).reshape(x.shape[0], H * Dh)
             ctx_new[op.name] = (ck, cv)
             return (
                 o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
@@ -350,7 +374,8 @@ def token_decode_step(model, w, tok, positions, caches, maxlen,
     }
 
 
-def prefill_forward(model, w, tokens_rows, caches, admit_mask, maxlen):
+def prefill_forward(model, w, tokens_rows, caches, admit_mask, maxlen,
+                    attention="naive"):
     """Full-sequence forward of a WAVE of (bucket-padded) prompts into
     their slots: every admitted slot's K/V for positions ``0..S-1``
     lands in its arena row in ONE pass — one program launch per
@@ -366,9 +391,18 @@ def prefill_forward(model, w, tokens_rows, caches, admit_mask, maxlen):
     position before its cursor makes it visible, so no per-row length
     mask is needed.
 
+    ``attention="flash"`` (ISSUE 11) runs the in-bucket causal core
+    through the tiled online-softmax kernel with static future-tile
+    skipping (:func:`elephas_tpu.ops.flash_serving.\
+flash_causal_prefill`) — ~half the FLOPs and O(S·block) live score
+    memory instead of the naive O(S²) matrix; float-tolerance parity,
+    temp-0 token-exact.
+
     Returns ``(logits [num_slots, S, vocab], new_caches)``."""
     import jax
     import jax.numpy as jnp
+
+    from elephas_tpu.ops.flash_serving import flash_causal_prefill
 
     ctx_new = {}
     S = int(tokens_rows.shape[1])
@@ -389,14 +423,21 @@ def prefill_forward(model, w, tokens_rows, caches, admit_mask, maxlen):
                 sin = jnp.asarray(sin_np)[None, None, :S]
                 q = _apply_rope(q, cos, sin)
                 k = _apply_rope(k, cos, sin)
-            att = jnp.einsum("bhid,bhjd->bhij", q, k) * (Dh**-0.5)
-            causal = (
-                jnp.arange(S)[None, :] <= jnp.arange(S)[:, None]
-            )[None, None]
-            att = jax.nn.softmax(
-                jnp.where(causal, att, -jnp.inf), axis=-1
-            )
-            o = jnp.einsum("bhij,bhjd->bhid", att, v)
+            if attention == "flash":
+                o = flash_causal_prefill(q, k, v, scale=Dh**-0.5)
+            else:
+                # flash-lint: allow — the selectable naive oracle
+                att = jnp.einsum(
+                    "bhid,bhjd->bhij", q, k
+                ) * (Dh**-0.5)
+                causal = (
+                    jnp.arange(S)[None, :] <= jnp.arange(S)[:, None]
+                )[None, None]
+                att = jax.nn.softmax(
+                    jnp.where(causal, att, -jnp.inf), axis=-1
+                )
+                # flash-lint: allow — naive oracle att@V
+                o = jnp.einsum("bhij,bhjd->bhid", att, v)
             o = jnp.reshape(
                 jnp.transpose(o, (0, 2, 1, 3)), (B, S, H * Dh)
             )
@@ -433,7 +474,8 @@ def prefill_forward(model, w, tokens_rows, caches, admit_mask, maxlen):
 
 
 def chunked_prefill_forward(model, w, tokens_chunk, caches, offsets,
-                            chunk_lens, active, maxlen):
+                            chunk_lens, active, maxlen,
+                            attention="naive", span=None):
     """Prefill a bounded CHUNK of each active slot's prompt, resuming
     from per-slot absolute offsets (ISSUE 4) — the program behind both
     chunked prefill (long prompts stream in ``prefill_chunk``-token
@@ -456,6 +498,12 @@ def chunked_prefill_forward(model, w, tokens_chunk, caches, offsets,
     prefilling this call. Padded/inactive lanes compute garbage that is
     never written and never read.
 
+    ``attention``/``span`` (ISSUE 11): as in :func:`token_decode_step`
+    — ``"flash"`` streams the updated arena row through the tiled
+    online-softmax kernel, ``span`` (static, ``None`` = ``maxlen``)
+    bounds the attended row to a span bucket covering every active
+    slot's ``offsets + chunk_lens``.
+
     Returns ``(logits [num_slots, C, vocab], new_caches)`` — the
     caller samples a finalizing slot's first token from the logits row
     at its prompt-end chunk index.
@@ -463,6 +511,9 @@ def chunked_prefill_forward(model, w, tokens_chunk, caches, offsets,
     import jax
     import jax.numpy as jnp
 
+    from elephas_tpu.ops.flash_serving import flash_span_chunk
+
+    S_att = int(maxlen if span is None else span)
     ctx_new = {}
     C = int(tokens_chunk.shape[1])
     # absolute positions of each slot's chunk rows, and the cache-write
@@ -511,15 +562,25 @@ def chunked_prefill_forward(model, w, tokens_chunk, caches, offsets,
             covered = jnp.any(write_sel, axis=2)[:, :, None, None]
             ck = jnp.where(covered, scat_k, ck)
             cv = jnp.where(covered, scat_v, cv)
-            att = jnp.einsum("bhcd,bshd->bhcs", q, ck) * (Dh**-0.5)
-            visible = (
-                jnp.arange(maxlen)[None, None, None, :]
-                <= pos_mat[:, None, :, None]
-            )
-            att = jax.nn.softmax(
-                jnp.where(visible, att, -jnp.inf), axis=-1
-            )
-            o = jnp.einsum("bhcs,bshd->bhcd", att, cv)
+            if attention == "flash":
+                o = flash_span_chunk(
+                    q, ck[:, :S_att], cv[:, :S_att], pos_mat,
+                    scale=Dh**-0.5,
+                )
+            else:
+                # flash-lint: allow — the selectable naive oracle
+                att = jnp.einsum(
+                    "bhcd,bshd->bhcs", q, ck[:, :S_att]
+                ) * (Dh**-0.5)
+                visible = (
+                    jnp.arange(S_att)[None, None, None, :]
+                    <= pos_mat[:, None, :, None]
+                )
+                att = jax.nn.softmax(
+                    jnp.where(visible, att, -jnp.inf), axis=-1
+                )
+                # flash-lint: allow — naive oracle att@V
+                o = jnp.einsum("bhcs,bshd->bhcd", att, cv[:, :S_att])
             o = jnp.reshape(
                 jnp.transpose(o, (0, 2, 1, 3)), (B, C, H * Dh)
             )
@@ -540,7 +601,7 @@ def chunked_prefill_forward(model, w, tokens_chunk, caches, offsets,
 
 
 def verify_forward(model, w, tokens_window, caches, offsets, n_fed,
-                   active, maxlen):
+                   active, maxlen, attention="naive", span=None):
     """Batched K-token speculative VERIFY over the slot arena (ISSUE
     8): slot ``b`` feeds ``n_fed[b]`` tokens — its last sampled token
     followed by up to ``K-1`` drafted guesses — at absolute positions
@@ -566,7 +627,8 @@ def verify_forward(model, w, tokens_window, caches, offsets, n_fed,
     feed before any query can see it (the same rewrite-before-visible
     invariant prefill padding already relies on)."""
     return chunked_prefill_forward(
-        model, w, tokens_window, caches, offsets, n_fed, active, maxlen
+        model, w, tokens_window, caches, offsets, n_fed, active, maxlen,
+        attention=attention, span=span,
     )
 
 

@@ -188,7 +188,8 @@ SlotKVCache`: host-side metadata only, the arrays are functional state
 
 
 def paged_token_decode_step(model, w, tok, positions, pool, tables,
-                            block_size, maxlen, active, local=False):
+                            block_size, maxlen, active, local=False,
+                            attention="naive"):
     """One decode step over the whole slot population, paged: slot
     ``b`` consumes ``tok[b]`` at absolute position ``positions[b]``,
     writes that position's K/V into pool row ``(tables[b, p // bs],
@@ -216,9 +217,17 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
     inside the decode loop (the measured ~15x hazard the fixed arena
     also avoids).
 
+    ``attention="flash"`` (ISSUE 11) runs the gathered table span
+    through the tiled online-softmax kernel
+    (:mod:`elephas_tpu.ops.flash_serving`) instead of materializing
+    the ``[B, H, S]`` score row — float-tolerance parity, temp-0
+    token-exact, same visible position set.
+
     Returns ``(logits [num_slots, vocab], new_pool)``."""
     import jax
     import jax.numpy as jnp
+
+    from elephas_tpu.ops.flash_serving import flash_span_decode
 
     bs = int(block_size)
     T = int(tables.shape[1])
@@ -314,17 +323,24 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
                 gv = jnp.einsum(
                     "btn,nohd->btohd", gsel.astype(pv.dtype), pv
                 ).reshape(B, S, H, Dh)
-            att = jnp.einsum("bhd,bshd->bhs", q, gk) * (Dh**-0.5)
-            visible = (
-                jnp.arange(S)[None, None, :]
-                <= positions[:, None, None]
-            )
-            att = jax.nn.softmax(
-                jnp.where(visible, att, -jnp.inf), axis=-1
-            )
-            o = jnp.einsum("bhs,bshd->bhd", att, gv).reshape(
-                B, H * Dh
-            )
+            if attention == "flash":
+                o = flash_span_decode(
+                    q, gk, gv, positions, scale=Dh**-0.5
+                ).reshape(B, H * Dh)
+            else:
+                # flash-lint: allow — the selectable naive oracle
+                att = jnp.einsum("bhd,bshd->bhs", q, gk) * (Dh**-0.5)
+                visible = (
+                    jnp.arange(S)[None, None, :]
+                    <= positions[:, None, None]
+                )
+                att = jax.nn.softmax(
+                    jnp.where(visible, att, -jnp.inf), axis=-1
+                )
+                # flash-lint: allow — naive oracle att@V
+                o = jnp.einsum("bhs,bshd->bhd", att, gv).reshape(
+                    B, H * Dh
+                )
             ctx_new[op.name] = (pk, pv)
             return (
                 o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
@@ -343,7 +359,7 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
 
 def paged_chunk_forward(model, w, tokens_chunk, pool, tables, offsets,
                         chunk_lens, active, block_size, maxlen,
-                        local=False):
+                        local=False, attention="naive"):
     """Prefill a bounded chunk of each active slot's prompt into its
     block-table rows — the ONLY prefill program paged mode needs: a
     cold prompt is one full-width chunk from offset 0 (or several under
@@ -357,11 +373,13 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
     over the gathered table span — shared prefix blocks, earlier
     chunks, and the chunk's own causal part. Compiled per (chunk width
     ``C``, table bucket ``T``) pair — both from closed ladders.
-    ``local`` as in :func:`paged_token_decode_step`.
+    ``local``/``attention`` as in :func:`paged_token_decode_step`.
 
     Returns ``(logits [num_slots, C, vocab], new_pool)``."""
     import jax
     import jax.numpy as jnp
+
+    from elephas_tpu.ops.flash_serving import flash_span_chunk
 
     bs = int(block_size)
     C = int(tokens_chunk.shape[1])
@@ -459,15 +477,24 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
                 gv = jnp.einsum(
                     "btn,nohd->btohd", gsel.astype(pv.dtype), pv
                 ).reshape(B, S, H, Dh)
-            att = jnp.einsum("bhcd,bshd->bhcs", q, gk) * (Dh**-0.5)
-            visible = (
-                jnp.arange(S)[None, None, None, :]
-                <= pos_mat[:, None, :, None]
-            )
-            att = jax.nn.softmax(
-                jnp.where(visible, att, -jnp.inf), axis=-1
-            )
-            o = jnp.einsum("bhcs,bshd->bhcd", att, gv)
+            if attention == "flash":
+                o = flash_span_chunk(
+                    q, gk, gv, pos_mat, scale=Dh**-0.5
+                )
+            else:
+                # flash-lint: allow — the selectable naive oracle
+                att = jnp.einsum(
+                    "bhcd,bshd->bhcs", q, gk
+                ) * (Dh**-0.5)
+                visible = (
+                    jnp.arange(S)[None, None, None, :]
+                    <= pos_mat[:, None, :, None]
+                )
+                att = jax.nn.softmax(
+                    jnp.where(visible, att, -jnp.inf), axis=-1
+                )
+                # flash-lint: allow — naive oracle att@V
+                o = jnp.einsum("bhcs,bshd->bhcd", att, gv)
             o = jnp.reshape(
                 jnp.transpose(o, (0, 2, 1, 3)), (B, C, H * Dh)
             )
@@ -489,7 +516,7 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
 
 def paged_verify_forward(model, w, tokens_window, pool, tables,
                          offsets, n_fed, active, block_size, maxlen,
-                         local=False):
+                         local=False, attention="naive"):
     """Batched K-token speculative verify over the PAGED arena (ISSUE
     8) — the block-table analogue of :func:`~elephas_tpu.serving.\
 kv_cache.verify_forward`: slot ``b`` feeds its last sampled token plus
@@ -507,7 +534,7 @@ kv_cache.verify_forward`: slot ``b`` feeds its last sampled token plus
     rows are rewritten before any query can see them."""
     return paged_chunk_forward(
         model, w, tokens_window, pool, tables, offsets, n_fed, active,
-        block_size, maxlen, local=local,
+        block_size, maxlen, local=local, attention=attention,
     )
 
 

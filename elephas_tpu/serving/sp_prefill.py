@@ -1,0 +1,120 @@
+"""Sequence-parallel long-prompt prefill for the serving engine
+(ISSUE 11, tentpole part 2).
+
+A single device's chunk prefill bounds how long a prompt the engine can
+ingest in reasonable TTFT: the O(T²) attention term runs on one chip no
+matter how the chunks are scheduled. This module removes that ceiling
+the way training already does (``parallel/sequence.py``): the prompt's
+sequence axis shards over an SP mesh axis, attention runs as a ring
+(``ops/ring_attention.py`` — KV shards rotate over ICI, queries stay
+put) or as Ulysses (``ops/ulysses.py`` — two all-to-alls around
+full-sequence attention per head group), and every other op is
+token-local so GSPMD runs it on the shards for free.
+
+The engine uses exactly ONE program from here:
+:func:`sp_prefill_forward` — a whole (power-of-two padded) prompt
+forward that returns both the per-position logits AND each attention
+layer's K/V rows. The engine lands those rows into the paged block
+pool (the same ``scatter_blocks`` program preemption-resume uses) and
+decode proceeds UNMESHED on the landed blocks — the SP mesh serves
+prefill only, so one long-prompt arrival borrows the mesh for one
+dispatch instead of sharding the whole server.
+
+Numerics: the ring/Ulysses cores are exact attention evaluated
+blockwise (log-sum-exp merges), so logits match the single-device
+prefill to float tolerance and temperature-0 first tokens exactly; the
+landed K/V rows are projections of the same hidden states — decode
+over them is token-exact at temperature 0 (asserted against a
+single-device engine in the tests).
+"""
+
+from __future__ import annotations
+
+from elephas_tpu.models.transformer import _apply_rope, _rope_tables
+from elephas_tpu.serving.kv_cache import _graph_replay, _slice_seq_prefix
+
+__all__ = ["sp_pad_len", "sp_prefill_forward"]
+
+
+def sp_pad_len(prompt_len: int, sp: int, maxlen: int) -> int | None:
+    """Padded sequence length for an SP prefill of ``prompt_len``
+    tokens over ``sp`` shards: the smallest power of two covering the
+    prompt that also tiles over the shards AND keeps each local shard
+    flash-tileable (a power-of-two local length is either ≤128 or a
+    multiple of 128, the Pallas kernel's block rule). Returns ``None``
+    when no such length fits ``maxlen`` — the caller falls back to the
+    single-device path, loudly."""
+    s = 1
+    while s < max(int(prompt_len), int(sp)):
+        s *= 2
+    return s if s <= maxlen else None
+
+
+def sp_prefill_forward(model, w, tokens, mesh, seq_axis: str,
+                       mechanism: str, maxlen: int):
+    """Full-prompt forward over the SP mesh, K/V captured per layer.
+
+    ``tokens``: ``[1, S]`` int32, ``S`` from :func:`sp_pad_len`
+    (padding tokens ride beyond the real prompt — causal attention
+    keeps them invisible to every real position, and their K/V rows
+    are either truncated by the caller or land past the resident
+    cursor where the rewrite-before-visible invariant covers them).
+
+    Returns ``(logits [1, S, vocab], {layer: (k, v)})`` with each
+    ``k``/``v`` of shape ``[S, H, Dh]`` — position-major rows ready to
+    reshape into pool blocks. Compiled once per padded length ``S``
+    (powers of two capped at ``maxlen`` — a closed set)."""
+    import jax.numpy as jnp
+
+    from elephas_tpu.ops.ring_attention import ring_attention_sharded
+    from elephas_tpu.ops.ulysses import ulysses_attention_sharded
+
+    S = int(tokens.shape[1])
+    ctx = {}
+
+    def attn_for(op):
+        def attn(x, *_a, **_k):
+            H, Dh = op.num_heads, op.head_dim
+            B = x.shape[0]  # 1
+            qkv = jnp.reshape(
+                x @ w[op.qkv.kernel.path], (B, S, 3, H, Dh)
+            )
+            qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # [3,B,H,S,Dh]
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            if getattr(op, "rope", False):
+                cos_np, sin_np = _rope_tables(maxlen, Dh)
+                cos = jnp.asarray(cos_np)[None, None, :S]
+                sin = jnp.asarray(sin_np)[None, None, :S]
+                q = _apply_rope(q, cos, sin)
+                k = _apply_rope(k, cos, sin)
+            if mechanism == "ulysses":
+                o = ulysses_attention_sharded(
+                    q, k, v, mesh, axis_name=seq_axis, causal=True,
+                    scale=Dh**-0.5,
+                )
+            else:
+                o = ring_attention_sharded(
+                    q.reshape(B * H, S, Dh),
+                    k.reshape(B * H, S, Dh),
+                    v.reshape(B * H, S, Dh),
+                    mesh, axis_name=seq_axis, causal=True,
+                    scale=Dh**-0.5,
+                ).reshape(B, H, S, Dh)
+            # position-major K/V rows for the block landing — the same
+            # rows single-device prefill would have written
+            ctx[op.name] = (
+                jnp.transpose(k[0], (1, 0, 2)),  # [S, H, Dh]
+                jnp.transpose(v[0], (1, 0, 2)),
+            )
+            o = jnp.reshape(
+                jnp.transpose(o, (0, 2, 1, 3)), (B, S, H * Dh)
+            )
+            return o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
+
+        return attn
+
+    logits = _graph_replay(
+        model, w, tokens, attn_for,
+        lambda a: _slice_seq_prefix(a, S, maxlen),
+    )
+    return logits, ctx

@@ -1207,6 +1207,7 @@ class SparkModel:
         tenants=None,
         gateway_port: int | None = None,
         gateway_host: str = "127.0.0.1",
+        attention: str = "flash",
     ):
         """A continuous-batching :class:`~elephas_tpu.serving.engine.\
 InferenceEngine` over this wrapper's mesh — the serving analogue of
@@ -1241,6 +1242,14 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
         to ``spec_k`` tokens per slot per round and one batched verify
         forward accepts the longest greedy-matching prefix — multiple
         tokens per target forward, temperature-0 output bit-exact.
+
+        ``attention=`` (ISSUE 11) selects the serving attention kernel:
+        ``"flash"`` (default) runs the tiled online-softmax programs —
+        O(span) score memory, causal tile-skipping in prefill,
+        span-bucketed block-span reads in decode; ``"naive"`` keeps
+        the full-materialized seed path as the parity oracle. Flash
+        matches naive to float tolerance and temperature-0 token
+        streams exactly (docs/API.md "Attention kernels").
 
         ``policy=`` / ``tenants=`` (ISSUE 10) install an SLO admission
         policy: ``"fair"`` (or just ``tenants={"name": weight}``) gets
@@ -1288,6 +1297,7 @@ Policy` instance. ``gateway_port=`` (0 = ephemeral) additionally
             spec_k=spec_k,
             spec_drafter=spec_drafter,
             policy=resolve_policy(policy, tenants),
+            attention=attention,
         )
         if gateway_port is not None:
             from elephas_tpu.serving.gateway import Gateway

@@ -250,7 +250,20 @@ class TestFaultPathLint:
                     os.path.join(root, "elephas_tpu", pkg, "*.py")
                 ))
             )
+        # ISSUE 11: the attention kernels the serving hot path now
+        # runs on (Pallas flash + the tiled serving kernels) — an
+        # eaten error inside a kernel wrapper silently serves wrong
+        # attention; pinned by name so a rename cannot drop them
+        files.append(os.path.join(
+            root, "elephas_tpu", "ops", "flash_attention.py"
+        ))
+        files.append(os.path.join(
+            root, "elephas_tpu", "ops", "flash_serving.py"
+        ))
         assert len(files) > 12  # the glob must actually find the modules
+        assert all(os.path.exists(f) for f in files), [
+            f for f in files if not os.path.exists(f)
+        ]
         # ISSUE 6: the sharded-topology module (scatter/gather, shard
         # maps, per-shard journals) is a fault path and must be under
         # this lint — pin it explicitly so a future rename cannot
@@ -267,6 +280,13 @@ class TestFaultPathLint:
         # a slot's resident-length bookkeeping silently wrong
         assert any(
             f.endswith(os.path.join("serving", "speculative.py"))
+            for f in files
+        )
+        # ISSUE 11: the SP prefill path lands K/V computed on another
+        # mesh into the pool — a swallowed error there is a silently
+        # garbage-prefilled request
+        assert any(
+            f.endswith(os.path.join("serving", "sp_prefill.py"))
             for f in files
         )
         # ISSUE 10: the gateway is a NETWORK fault path (half-open
@@ -334,7 +354,19 @@ class TestTelemetryWallClockLint:
                     os.path.join(root, "elephas_tpu", pkg, "*.py")
                 ))
             )
+        # ISSUE 11: the attention kernels run INSIDE gang-replicated
+        # programs — wall clock there would fork compiled behavior
+        # across processes; pinned by name like the serving modules
+        files.append(os.path.join(
+            root, "elephas_tpu", "ops", "flash_attention.py"
+        ))
+        files.append(os.path.join(
+            root, "elephas_tpu", "ops", "flash_serving.py"
+        ))
         assert len(files) > 9
+        assert all(os.path.exists(f) for f in files), [
+            f for f in files if not os.path.exists(f)
+        ]
         # ISSUE 7: the paged scheduler/allocator order a gang-
         # replicated schedule — wall clock there forks SPMD processes
         assert any(f.endswith("paged_kv.py") for f in files)
@@ -352,6 +384,12 @@ class TestTelemetryWallClockLint:
         # not smuggle wall time into submit ordering either
         assert any(
             f.endswith(os.path.join("serving", "policy.py"))
+            for f in files
+        )
+        # ISSUE 11: the SP prefill module feeds a gang-replicated
+        # landing path the same way
+        assert any(
+            f.endswith(os.path.join("serving", "sp_prefill.py"))
             for f in files
         )
         assert any(
@@ -375,6 +413,55 @@ class TestTelemetryWallClockLint:
             "through elephas_tpu.telemetry (events capture wall time "
             "export-only) or tag the line with "
             "'telemetry-lint: allow <reason>':\n" + "\n".join(offences)
+        )
+
+
+class TestFlashAttentionLint:
+    """ISSUE 11 satellite: the serving hot path runs tiled
+    online-softmax attention (``ops/flash_serving.py``) — a
+    full-materialized score matrix creeping back into ``serving/`` is
+    exactly how the O(T²) memory term the flash graft removed returns
+    silently (it would still be CORRECT, so no test would catch it;
+    only the TTFT/memory regression would, months later). This
+    grep-lint fails any attention-score einsum in ``elephas_tpu/
+    serving/`` — an ``jnp.einsum`` whose output is a ``[.., query,
+    key]`` score matrix (``->bhs`` / ``->bhcs`` / ``->bhij`` and their
+    att@V consumers) — unless the line carries an explicit
+    ``flash-lint: allow`` tag with a reason. The naive-fallback path
+    (the parity oracle ``attention="naive"`` keeps selectable) is
+    tagged; new untagged materializations fail."""
+
+    # score-matrix producers and their att@V consumers: the shapes the
+    # naive kernels materialize ([B,H,(C,)S] / [B,H,S,S] scores).
+    # \s* spans newlines — the einsum spec often sits on its own line.
+    _SCORE_EINSUM = re.compile(
+        r'jnp\.einsum\(\s*"[^"]*->(?:bhs|bhcs|bhij)"'
+        r'|jnp\.einsum\(\s*"(?:bhs|bhcs|bhij)[^"]*->'
+    )
+
+    def test_no_untagged_materialized_attention_in_serving(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        files = sorted(glob.glob(
+            os.path.join(root, "elephas_tpu", "serving", "*.py")
+        ))
+        assert len(files) > 8
+        offences = []
+        for path in files:
+            with open(path) as f:
+                text = f.read()
+            lines = text.splitlines()
+            for match in self._SCORE_EINSUM.finditer(text):
+                i = text.count("\n", 0, match.start())  # 0-based line
+                window = lines[max(0, i - 2): min(len(lines), i + 3)]
+                if any("flash-lint: allow" in w for w in window):
+                    continue
+                rel = os.path.relpath(path, root)
+                offences.append(f"{rel}:{i + 1}: {lines[i].strip()}")
+        assert not offences, (
+            "full-materialized attention einsum in serving/ outside "
+            "the tagged naive-fallback path — route it through "
+            "ops/flash_serving (or tag the line with 'flash-lint: "
+            "allow <reason>'):\n" + "\n".join(offences)
         )
 
 

@@ -278,6 +278,39 @@ def test_moe_layer_shards_experts_under_tp():
     assert np.isfinite(history["loss"]).all()
 
 
+def test_moe_stateless_grad_lowering_pinned():
+    """Regression pin (ISSUE 11): the seed's MoE tier-1 failures all
+    reduced to THIS lowering shape — ``jax.grad`` through
+    ``MoeFFN.stateless_call`` (what every SparkModel training step
+    runs). Raw keras Variables inside ``jnp`` ops are not valid JAX
+    types (jax dropped the ``__jax_array__`` auto-convert), so the
+    layer must read ``.value`` explicitly; under the stateless scope
+    that resolves to the traced array and gradients flow. This test
+    fails within seconds if the unwrap regresses — no SparkModel fit
+    needed to see it."""
+    import keras
+
+    from elephas_tpu.models.switch import MoeFFN
+
+    keras.utils.set_random_seed(0)
+    layer = MoeFFN(4, 32, k=2, name="moe_pin")
+    layer.build((None, 16))
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(8, 16)), jnp.float32
+    )
+    tv = [v.value for v in layer.trainable_variables]
+    ntv = [v.value for v in layer.non_trainable_variables]
+
+    def loss(tv):
+        out, _ntv2, losses = layer.stateless_call(
+            tv, ntv, x, training=True, return_losses=True
+        )
+        return jnp.sum(out**2) + sum(losses)
+
+    grads = jax.jit(jax.grad(loss))(tv)
+    assert any(float(jnp.abs(g).max()) > 0 for g in grads)
+
+
 def test_topk_rejects_k_above_num_experts():
     from elephas_tpu.ops.moe import _topk_dispatch
     from elephas_tpu.models.switch import MoeFFN

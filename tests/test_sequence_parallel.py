@@ -118,6 +118,48 @@ def test_sp_matches_unsharded_training(attention, sp, dp, mp, heads):
         np.testing.assert_allclose(e1[key], e2[key], rtol=5e-3, err_msg=key)
 
 
+def test_noncausal_ring_jit_lowering_pinned():
+    """Regression pin (ISSUE 11): the seed's 3 SP tier-1 failures all
+    reduced to THIS lowering shape — a NON-causal ring inside jit.
+    The ring's scan body computed ``axis_index`` unconditionally; on
+    the non-causal path nothing consumed it, the dead instruction
+    survived into the lowered module, and XLA's SPMD partitioner
+    refused the orphaned ``PartitionId`` ("not supported for SPMD
+    partitioning"). Causal rings (where the switch consumes it) never
+    showed it — which is why every LM test stayed green while
+    classifier evaluate/predict died. Pin BOTH directions: the jit
+    must compile AND match unsharded flash attention."""
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.ops.flash_attention import attention_reference
+    from elephas_tpu.parallel.mesh import shard_map_compat
+    from jax.sharding import PartitionSpec as P
+
+    mesh = dp_sp_mesh(sequence_parallel=4)
+    bh, S, D = 4, 32, 8
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(bh, S, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(bh, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(bh, S, D)), jnp.float32)
+    from elephas_tpu.ops.ring_attention import ring_attention
+
+    for causal in (False, True):  # False is the regression; True the control
+        fn = lambda a, b, c: ring_attention(  # noqa: E731
+            a, b, c, axis_name="seq", causal=causal
+        )
+        sharded = shard_map_compat(
+            fn, mesh=mesh, in_specs=(P(None, "seq", None),) * 3,
+            out_specs=P(None, "seq", None), check=False,
+        )
+        out = jax.jit(lambda a, b, c: sharded(a, b, c))(q, k, v)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
+            err_msg=f"causal={causal}",
+        )
+
+
 def test_sp_weights_replicate_activations_shard():
     m = _tiny_transformer(seed=1)
     t = SequenceShardedTrainer(m, sequence_parallel=4)

@@ -1,0 +1,443 @@
+"""Flash serving attention + SP long-prompt prefill + MoE serving
+(ISSUE 11).
+
+Parity culture as everywhere in the repo: the naive full-materialized
+kernel stays selectable (``attention="naive"``) as the oracle, flash
+must match it to float tolerance on logits-bearing outputs and EXACTLY
+on temperature-0 token streams — across every serving program (full
+prefill, chunked, paged chunk, both verify programs, decode block-span
+reads), TP mesh included. SP prefill must land the same tokens a
+single-device engine produces. Compile sets stay closed (second
+identical pass compiles nothing new).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from elephas_tpu.ops.flash_serving import (
+    flash_causal_prefill,
+    flash_span_chunk,
+    flash_span_decode,
+    span_bucket_for,
+    span_buckets,
+)
+from elephas_tpu.serving import InferenceEngine
+
+
+# -- kernel units --------------------------------------------------------
+
+
+def _naive_span(q, gk, gv, pos_mat, scale):
+    att = jnp.einsum("bhcd,bshd->bhcs", q, gk) * scale
+    vis = (
+        jnp.arange(gk.shape[1])[None, None, None, :]
+        <= pos_mat[:, None, :, None]
+    )
+    att = jax.nn.softmax(jnp.where(vis, att, -jnp.inf), axis=-1)
+    return jnp.einsum("bhcs,bshd->bhcd", att, gv)
+
+
+def test_flash_kernels_match_naive_oracle():
+    """The three tiled kernels reproduce the naive einsum/softmax math
+    to float32 tolerance on ragged (non-tile-multiple) shapes."""
+    rng = np.random.default_rng(0)
+    B, H, C, Dh, S = 3, 2, 5, 8, 37  # S deliberately not 16-aligned
+    q = jnp.asarray(rng.normal(size=(B, H, C, Dh)), jnp.float32)
+    gk = jnp.asarray(rng.normal(size=(B, S, H, Dh)), jnp.float32)
+    gv = jnp.asarray(rng.normal(size=(B, S, H, Dh)), jnp.float32)
+    pos = jnp.asarray(rng.integers(0, S, size=(B, C)), jnp.int32)
+    scale = Dh**-0.5
+    np.testing.assert_allclose(
+        np.asarray(flash_span_chunk(q, gk, gv, pos, scale, block_k=16)),
+        np.asarray(_naive_span(q, gk, gv, pos, scale)),
+        atol=1e-5, rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(
+            flash_span_decode(q[:, :, 0], gk, gv, pos[:, 0], scale,
+                              block_k=16)
+        ),
+        np.asarray(_naive_span(q, gk, gv, pos, scale)[:, :, 0]),
+        atol=1e-5, rtol=1e-5,
+    )
+    S2 = 29
+    q2 = jnp.asarray(rng.normal(size=(B, H, S2, Dh)), jnp.float32)
+    k2 = jnp.asarray(rng.normal(size=(B, H, S2, Dh)), jnp.float32)
+    v2 = jnp.asarray(rng.normal(size=(B, H, S2, Dh)), jnp.float32)
+    att = jnp.einsum("bhid,bhjd->bhij", q2, k2) * scale
+    causal = (
+        jnp.arange(S2)[None, :] <= jnp.arange(S2)[:, None]
+    )[None, None]
+    ref = jnp.einsum(
+        "bhij,bhjd->bhid",
+        jax.nn.softmax(jnp.where(causal, att, -jnp.inf), axis=-1), v2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(
+            flash_causal_prefill(q2, k2, v2, scale, block_q=8, block_k=8)
+        ),
+        np.asarray(ref), atol=1e-5, rtol=1e-5,
+    )
+
+
+def test_flash_fully_masked_rows_are_zero_not_nan():
+    """Inactive lanes (position below every cache row) must come out
+    finite — the naive path's NaN garbage is never read, but flash
+    promises exact zeros."""
+    B, H, C, Dh, S = 2, 1, 2, 4, 8
+    q = jnp.ones((B, H, C, Dh), jnp.float32)
+    gk = jnp.ones((B, S, H, Dh), jnp.float32)
+    pos = jnp.full((B, C), -1, jnp.int32)  # nothing visible
+    out = flash_span_chunk(q, gk, gk, pos, 1.0)
+    assert np.all(np.asarray(out) == 0.0)
+
+
+def test_span_bucket_ladder():
+    assert span_buckets(1024) == (64, 128, 256, 512, 1024)
+    assert span_buckets(32) == (32,)
+    assert span_buckets(100) == (64, 100)
+    assert span_bucket_for(1, (64, 128)) == 64
+    assert span_bucket_for(65, (64, 128)) == 128
+    with pytest.raises(ValueError, match="exceeds"):
+        span_bucket_for(200, (64, 128))
+    with pytest.raises(ValueError, match="positive"):
+        span_buckets(0)
+
+
+# -- engine parity: flash vs naive vs one-shot ---------------------------
+
+
+def _workload(maxlen, seed=0):
+    """Mixed-length prompts from the serving_lm's token alphabet."""
+    rng = np.random.default_rng(seed)
+    plens = (3, 5, 9, 17)
+    return [
+        (
+            (rng.integers(2, 6, size=plens[i % len(plens)])
+             .astype(np.int32)),
+            int(6 + (i % 3) * 3),
+        )
+        for i in range(8)
+    ]
+
+
+def _drain(engine, workload):
+    out = engine.run([(p, mn) for p, mn in workload])
+    return [seq.tolist() for _rid, seq in sorted(out.items())]
+
+
+def test_flash_vs_naive_engine_parity(serving_lm):
+    """Fixed arena: the flash engine's temp-0 tokens match the naive
+    engine's AND one-shot generate() per request."""
+    from elephas_tpu.models import generate
+
+    wl = _workload(32)
+    seqs = {}
+    for kernel in ("flash", "naive"):
+        eng = InferenceEngine(serving_lm, num_slots=4, attention=kernel)
+        assert eng.compile_stats()["attention"] == kernel
+        seqs[kernel] = _drain(eng, wl)
+        eng.release_telemetry()
+    assert seqs["flash"] == seqs["naive"]
+    for (p, mn), got in zip(wl, seqs["flash"]):
+        ref = generate(serving_lm, p[None], steps=mn, kv_cache=True)[0]
+        assert got == ref.tolist()[: len(got)]
+
+
+def test_flash_parity_chunked_prefill(serving_lm):
+    """Chunked prefill (the budgeted long-prompt path) is token-exact
+    across kernels."""
+    wl = _workload(32, seed=1)
+    seqs = {}
+    for kernel in ("flash", "naive"):
+        eng = InferenceEngine(
+            serving_lm, num_slots=2, attention=kernel,
+            prefill_chunk=8, prefill_budget=16,
+        )
+        seqs[kernel] = _drain(eng, wl)
+        eng.release_telemetry()
+    assert seqs["flash"] == seqs["naive"]
+
+
+def test_flash_parity_paged(serving_lm):
+    """Paged arena (block-table gather + flash over the table span),
+    prefix cache on: token-exact across kernels."""
+    wl = _workload(32, seed=2)
+    seqs = {}
+    for kernel in ("flash", "naive"):
+        eng = InferenceEngine(
+            serving_lm, num_slots=4, attention=kernel,
+            paged=True, block_size=8, prefix_cache=True,
+        )
+        seqs[kernel] = _drain(eng, wl)
+        eng.release_telemetry()
+    assert seqs["flash"] == seqs["naive"]
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_flash_parity_speculative_verify(serving_lm, paged):
+    """Both verify programs (fixed verify_forward and
+    paged_verify_forward) under flash: speculative decode stays
+    token-exact vs the naive speculative engine AND vs the plain flash
+    engine (speculation never changes greedy output)."""
+    wl = _workload(32, seed=3)
+    seqs = {}
+    for kernel in ("flash", "naive"):
+        kw = dict(paged=True, block_size=8) if paged else {}
+        eng = InferenceEngine(
+            serving_lm, num_slots=2, attention=kernel,
+            speculative=True, spec_k=3, **kw,
+        )
+        seqs[kernel] = _drain(eng, wl)
+        eng.release_telemetry()
+    assert seqs["flash"] == seqs["naive"]
+    plain = InferenceEngine(serving_lm, num_slots=2, attention="flash")
+    assert _drain(plain, wl) == seqs["flash"]
+    plain.release_telemetry()
+
+
+def test_flash_parity_tp_mesh(serving_lm):
+    """TP mesh: flash engine tokens match the unmeshed flash engine
+    (heads shard over the model axis; the tiled einsums partition the
+    same way the naive ones did)."""
+    from elephas_tpu.parallel.tensor import dp_tp_mesh
+
+    wl = _workload(32, seed=4)
+    ref = InferenceEngine(serving_lm, num_slots=4, attention="flash")
+    want = _drain(ref, wl)
+    ref.release_telemetry()
+    mesh = dp_tp_mesh(model_parallel=2)
+    eng = InferenceEngine(
+        serving_lm, num_slots=4, mesh=mesh, batch_axes=("data",),
+        model_axis="model", attention="flash",
+    )
+    assert _drain(eng, wl) == want
+    eng.release_telemetry()
+
+
+def test_flash_closed_compile_set(serving_lm):
+    """Second identical pass compiles NOTHING new, and the decode
+    compile count stays inside the span-bucket ladder (one bucket for
+    this maxlen-32 model — the seed's single-decode contract holds)."""
+    wl = _workload(32, seed=5)
+    eng = InferenceEngine(
+        serving_lm, num_slots=4, attention="flash", speculative=True,
+        spec_k=3,
+    )
+    _drain(eng, wl)
+    first = eng.compile_stats()
+    assert first["decode_compiles"] <= len(first["span_buckets"])
+    _drain(eng, wl)
+    assert eng.compile_stats() == first
+    eng.release_telemetry()
+
+
+def test_attention_knob_validation(serving_lm):
+    with pytest.raises(ValueError, match="attention"):
+        InferenceEngine(serving_lm, num_slots=2, attention="fused")
+    eng = InferenceEngine(serving_lm, num_slots=2)
+    try:
+        assert eng.compile_stats()["attention"] == "flash"  # default
+        scrape = eng.scrape()
+        assert 'elephas_serving_attn_kernel' in scrape
+        assert 'kernel="flash"' in scrape
+    finally:
+        eng.release_telemetry()
+
+
+def test_prefill_bucket_histogram(serving_lm):
+    """The per-bucket prefill-token histogram records one observation
+    per completed prefill, labeled by its compiled bucket."""
+    eng = InferenceEngine(serving_lm, num_slots=2)
+    try:
+        eng.run([(np.array([2, 3, 4], np.int32), 4),
+                 (np.arange(2, 20, dtype=np.int32) % 4 + 2, 4)])
+        scrape = eng.scrape()
+        assert "elephas_serving_prefill_tokens" in scrape
+        assert 'bucket="16"' in scrape  # the 3-token prompt's bucket
+        assert 'bucket="32"' in scrape  # the 18-token prompt's bucket
+    finally:
+        eng.release_telemetry()
+
+
+# -- sequence-parallel long-prompt prefill -------------------------------
+
+
+@pytest.mark.parametrize("mechanism", ["ring", "ulysses"])
+def test_sp_prefill_token_exact(serving_lm, mechanism):
+    """A long prompt prefilled over the SP mesh decodes the exact
+    token stream of the single-device paged engine, and short prompts
+    below the threshold keep the normal path."""
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(0)
+    long_prompt = (rng.integers(2, 6, size=20)).astype(np.int32)
+    short = np.array([2, 3, 4], np.int32)
+    wl = [(long_prompt, 8), (short, 5)]
+    ref = InferenceEngine(serving_lm, num_slots=2, paged=True,
+                          block_size=8)
+    want = _drain(ref, wl)
+    ref.release_telemetry()
+    sp_mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    eng = InferenceEngine(
+        serving_lm, num_slots=2, paged=True, block_size=8,
+        sp_prefill=sp_mesh, sp_threshold=16, sp_mechanism=mechanism,
+    )
+    try:
+        assert _drain(eng, wl) == want
+        stats = eng.compile_stats()
+        assert stats["sp_prefill_compiles"] == 1  # one padded length
+        # the long prompt went through the SP path (histogram labeled
+        # by its padded length), the short one through a normal bucket
+        scrape = eng.scrape()
+        assert 'bucket="sp32"' in scrape
+        # second identical long prompt compiles nothing new
+        eng.run([(long_prompt, 8)])
+        assert eng.compile_stats() == stats
+    finally:
+        eng.release_telemetry()
+
+
+def test_sp_prefill_trace_span(serving_lm):
+    """Chrome traces show where long prompts spend TTFT: the SP
+    dispatch emits a serve.sp_prefill span."""
+    from jax.sharding import Mesh
+
+    from elephas_tpu import telemetry
+
+    rng = np.random.default_rng(1)
+    long_prompt = (rng.integers(2, 6, size=20)).astype(np.int32)
+    sp_mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    eng = InferenceEngine(
+        serving_lm, num_slots=2, paged=True, block_size=8,
+        sp_prefill=sp_mesh, sp_threshold=16,
+    )
+    try:
+        eng.run([(long_prompt, 4)])
+        names = [e["name"] for e in telemetry.tracer().events()]
+        assert "serve.sp_prefill" in names
+    finally:
+        eng.release_telemetry()
+
+
+def test_sp_prefill_knob_validation(serving_lm):
+    from jax.sharding import Mesh
+
+    from elephas_tpu.parallel.tensor import dp_tp_mesh
+
+    sp_mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(serving_lm, num_slots=2, sp_prefill=sp_mesh)
+    with pytest.raises(ValueError, match="UNMESHED"):
+        InferenceEngine(
+            serving_lm, num_slots=2, paged=True,
+            mesh=dp_tp_mesh(model_parallel=2), batch_axes=("data",),
+            model_axis="model", sp_prefill=sp_mesh,
+        )
+    with pytest.raises(ValueError, match="sp_axis"):
+        InferenceEngine(
+            serving_lm, num_slots=2, paged=True, sp_prefill=sp_mesh,
+            sp_axis="workers",
+        )
+    with pytest.raises(ValueError, match="mechanism"):
+        InferenceEngine(
+            serving_lm, num_slots=2, paged=True, sp_prefill=sp_mesh,
+            sp_mechanism="tree",
+        )
+    with pytest.raises(ValueError, match="num_heads divisible"):
+        # serving_lm has 2 heads; a 4-wide seq axis cannot ulysses
+        InferenceEngine(
+            serving_lm, num_slots=2, paged=True,
+            sp_prefill=Mesh(np.array(jax.devices()[:4]), ("seq",)),
+            sp_mechanism="ulysses",
+        )
+    with pytest.raises(ValueError, match="power-of-two"):
+        # pad lengths are powers of two; a 3-wide axis divides none
+        InferenceEngine(
+            serving_lm, num_slots=2, paged=True,
+            sp_prefill=Mesh(np.array(jax.devices()[:3]), ("seq",)),
+        )
+    with pytest.raises(ValueError, match="require sp_prefill"):
+        InferenceEngine(serving_lm, num_slots=2, sp_threshold=8)
+
+
+# -- MoE serving ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def switch_lm():
+    """A small MoE decoder LM with ample expert capacity (k·cf ≥ E →
+    no token ever drops, so per-program routing populations cannot
+    change the output — the parity precondition the zoo documents)."""
+    from elephas_tpu.models import switch_transformer_lm
+
+    return switch_transformer_lm(
+        vocab_size=16, maxlen=32, d_model=32, num_heads=2,
+        num_layers=1, num_experts=2, k=2, capacity_factor=2.0,
+        dropout=0.0, seed=0,
+    )
+
+
+def test_switch_moe_serves_fixed_and_paged(switch_lm):
+    """The MoE scenario opens: switch_transformer_lm serves through
+    the continuous-batching engine, token-exact vs one-shot
+    generate() on both arenas."""
+    from elephas_tpu.models import generate
+
+    prompts = [np.array([2, 3, 4, 5], np.int32),
+               np.array([4, 5, 2], np.int32)]
+    ref = [
+        generate(switch_lm, p[None], steps=6, kv_cache=True)[0].tolist()
+        for p in prompts
+    ]
+    for kw in ({}, {"paged": True, "block_size": 8}):
+        eng = InferenceEngine(switch_lm, num_slots=2, **kw)
+        got = _drain(eng, [(p, 6) for p in prompts])
+        for g, r in zip(got, ref):
+            assert g == r[: len(g)]
+        eng.release_telemetry()
+
+
+def test_switch_moe_serves_expert_parallel_tp(switch_lm):
+    """Expert-parallel serving: under a TP mesh the planner shards the
+    [E, ...] expert weights over the model axis (the staged serving
+    weights prove it) and decode stays token-exact."""
+    from elephas_tpu.parallel.tensor import dp_tp_mesh
+
+    prompts = [np.array([2, 3, 4, 5], np.int32),
+               np.array([4, 5, 2], np.int32)]
+    ref_eng = InferenceEngine(switch_lm, num_slots=2)
+    want = _drain(ref_eng, [(p, 6) for p in prompts])
+    ref_eng.release_telemetry()
+    eng = InferenceEngine(
+        switch_lm, num_slots=2, mesh=dp_tp_mesh(model_parallel=2),
+        batch_axes=("data",), model_axis="model",
+    )
+    try:
+        expert_specs = {
+            path: str(w.sharding.spec)
+            for path, w in eng._weights.items() if "expert_w" in path
+        }
+        assert expert_specs and all(
+            "model" in s for s in expert_specs.values()
+        ), expert_specs
+        assert _drain(eng, [(p, 6) for p in prompts]) == want
+    finally:
+        eng.release_telemetry()
+
+
+def test_switch_moe_speculative_serving(switch_lm):
+    """MoE composes with speculative decoding (the verify program
+    routes its window tokens through the same expert math)."""
+    prompts = [np.array([2, 3, 4, 5], np.int32)]
+    ref_eng = InferenceEngine(switch_lm, num_slots=1)
+    want = _drain(ref_eng, [(p, 8) for p in prompts])
+    ref_eng.release_telemetry()
+    eng = InferenceEngine(
+        switch_lm, num_slots=1, speculative=True, spec_k=3,
+    )
+    assert _drain(eng, [(p, 8) for p in prompts]) == want
+    eng.release_telemetry()
