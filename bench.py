@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import gc
 import json
 import logging
 import os
@@ -1188,7 +1189,7 @@ def _serving_flashprefill_section(rounds=5, num_slots=2, maxlen=512):
 
 
 def _serving_telemetry_section(model, maxlen, vocab, num_slots,
-                               rounds=5):
+                               rounds=8):
     """Telemetry-overhead check (ISSUE 5 satellite): the same workload
     through two engines — one built with the live registry, one built
     under telemetry null mode — in alternating rounds (the ps/serving
@@ -1215,7 +1216,14 @@ def _serving_telemetry_section(model, maxlen, vocab, num_slots,
     each engine's closest-to-unloaded speed and the comparison of
     maxima is robust to one-sided noise the way a median of wild
     rounds is not. Rounds still alternate, and windows are sized so a
-    single descheduling blip cannot dominate."""
+    single descheduling blip cannot dominate. (ISSUE 12 bumped the
+    default rounds 5 → 8: the best-window estimator needs enough
+    draws that BOTH engines hit a quiet patch of this shared box —
+    with 5, one lucky null window occasionally outran every "on"
+    window and the retry loop burned all its attempts re-measuring
+    ambient noise. The 2% bar itself is unchanged, and the "on"
+    engine now carries the FULL ISSUE-12 stack: flight recorder,
+    lifecycle events, rid exemplars, compile watching.)"""
     import numpy as np
 
     from elephas_tpu import telemetry
@@ -1228,21 +1236,54 @@ def _serving_telemetry_section(model, maxlen, vocab, num_slots,
          budget)
         for i in range(16)
     ]
+    # both engines run multi-step scheduling (steps_per_sync=4), the
+    # engine's production serving shape: per-WINDOW host work (span,
+    # staging, dispatch) amortizes over the window exactly as it does
+    # in deployment, so the measured tax is the per-token recording
+    # cost — not the 1-CPU CI box's per-window host floor, which the
+    # k=1 shape charged 4x as often and which no accelerator
+    # deployment pays at that rate
     was_null = telemetry.set_null(True)
     try:
-        eng_null = InferenceEngine(model, num_slots=num_slots)
+        eng_null = InferenceEngine(
+            model, num_slots=num_slots, steps_per_sync=4,
+        )
     finally:
         telemetry.set_null(was_null)
-    engines = {"on": InferenceEngine(model, num_slots=num_slots),
-               "null": eng_null}
+    # the "on" engine runs with the FLIGHT RECORDER armed (ISSUE 12):
+    # the ≤2% tax gate below covers the full observability stack —
+    # registry counters, rid exemplars, lifecycle events, AND the
+    # per-request record path — not just the PR-5 counters
+    engines = {
+        "on": InferenceEngine(
+            model, num_slots=num_slots, steps_per_sync=4,
+            flight_recorder=256,
+        ),
+        "null": eng_null,
+    }
     for eng in engines.values():
         eng.run(workload)  # compile warmup
     tax = None
     tps = {"on": [], "null": []}
     for attempt in range(MEASURE_RETRIES):
+        # each attempt measures FRESH windows (ISSUE 12): the old
+        # accumulate-and-recompute retry could never recover from one
+        # early lucky null window — its max poisoned every later
+        # attempt and the "re-measuring" was theater (observed as the
+        # identical tax across all three attempts). A fresh attempt
+        # gives BOTH engines a new shot at a quiet patch of the box.
+        att = {"on": [], "null": []}
         for _r in range(rounds):
             for label, eng in engines.items():
                 reqs = [eng.submit(p, mn) for p, mn in workload]
+                # GC hygiene (ISSUE 12): start each timed window from
+                # a collected heap so one engine's garbage cannot be
+                # charged to the OTHER engine's window — collections
+                # the window's own allocations trigger still land in
+                # it (that cost is real and stays measured). On the
+                # 1-CPU CI box a gen2 pause is several % of a window,
+                # and which alternating round ate it was pure luck.
+                gc.collect()
                 t0 = time.perf_counter()
                 eng.run()
                 dt = time.perf_counter() - t0
@@ -1251,10 +1292,12 @@ def _serving_telemetry_section(model, maxlen, vocab, num_slots,
                         f"telemetry-overhead round {dt:.4f}s below the "
                         f"{MIN_CREDIBLE_DT}s credibility floor"
                     )
-                tps[label].append(
+                att[label].append(
                     sum(len(r.tokens) for r in reqs) / dt
                 )
-        tax = 1.0 - max(tps["on"]) / max(tps["null"])
+        tps["on"].extend(att["on"])
+        tps["null"].extend(att["null"])
+        tax = 1.0 - max(att["on"]) / max(att["null"])
         if tax < 0.02:
             break
         log.warning(
@@ -1270,13 +1313,29 @@ def _serving_telemetry_section(model, maxlen, vocab, num_slots,
         )
     scrape = engines["on"].scrape()
     assert "elephas_serving_tokens_generated_total" in scrape
+    # the recorder must have been LIVE during the measured windows
+    # (ISSUE 12): a finished request explains, and the OpenMetrics
+    # scrape carries rid exemplars on the latency histograms — the
+    # tax above was paid by the real record path, not a disabled one
+    eng_on = engines["on"]
+    some_rid = max(eng_on.finished)  # newest: surely still in the ring
+    record = eng_on.explain(some_rid)
+    assert record["finish"] is not None and record["token_steps"]
+    assert '# {rid="' in eng_on.scrape(openmetrics=True)
     return {
-        "tok_s_on": round(max(tps["on"]), 1),
-        "tok_s_null": round(max(tps["null"]), 1),
+        # maxima from the PASSING attempt's windows — the ones the
+        # gate actually judged — so recomputing 1 - on/null from the
+        # published fields reproduces overhead_frac (an earlier
+        # attempt's lucky window must not make the record contradict
+        # its own gate); medians stay all-window descriptive stats
+        "tok_s_on": round(max(att["on"]), 1),
+        "tok_s_null": round(max(att["null"]), 1),
         "tok_s_on_median": round(float(np.median(tps["on"])), 1),
         "tok_s_null_median": round(float(np.median(tps["null"])), 1),
         "overhead_frac": round(max(0.0, tax), 4),
         "rounds_timed": len(tps["on"]),
+        "flight_recorder_on": True,
+        "flight_records": len(eng_on._flight),
         "scrape_bytes": len(scrape),
     }
 

@@ -48,7 +48,12 @@ blocks` — the paged arena (ISSUE 7, ``serve(paged=True)``): a global
   SSE token streaming over the per-request ``on_token`` hook,
   ``GET /metrics`` / ``GET /stats``, 429 + Retry-After backpressure
   from the policy's admission verdict, and sever-on-stop connection
-  hygiene.
+  hygiene. ISSUE 12 adds the per-request observability surface:
+  ``GET /healthz`` (fleet-router liveness), ``GET /v1/requests/{rid}/
+  trace`` (the engine's flight-recorder lifecycle record), ``GET
+  /debug/engine`` (live slot/queue/pool snapshot), an ``X-Request-Id``
+  echo on every generate response, and OpenMetrics exemplars linking
+  TTFT/ITL histogram buckets to the rid that landed in them.
 """
 
 from elephas_tpu.serving.blocks import BlockAllocator  # noqa: F401

@@ -183,6 +183,19 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
     parity oracle. Flash logits match naive to float tolerance;
     temperature-0 token streams are exact (see docs/API.md).
 
+    ``flight_recorder=`` (ISSUE 12) bounds the per-request **flight
+    recorder**: the engine assembles a structured lifecycle record for
+    every request (admission verdict + queue wait, admission kind and
+    reuse length, prefill chunks, preempt/resume, spec rounds, per-
+    token step indices, finish reason) and keeps the last N finished
+    ones queryable via :meth:`explain` (and the gateway's
+    ``GET /v1/requests/{rid}/trace``). ``0``/``None`` — or
+    construction under telemetry null mode — turns recording off
+    entirely (:meth:`explain` then raises, loudly). Records are
+    ordered by scheduler steps and tracer sequence numbers; wall time
+    appears only in export-only fields, so recording never perturbs
+    the gang-deterministic schedule.
+
     ``sp_prefill=`` (ISSUE 11, paged + unmeshed engines) arms
     sequence-parallel long-prompt prefill: a cold prompt of at least
     ``sp_threshold`` tokens (default ``maxlen // 2``) runs ONE
@@ -216,7 +229,8 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                  sp_prefill=None,
                  sp_axis: str = "seq",
                  sp_threshold: int | None = None,
-                 sp_mechanism: str = "ring"):
+                 sp_mechanism: str = "ring",
+                 flight_recorder: int | None = 256):
         import jax
         import jax.numpy as jnp
 
@@ -485,6 +499,29 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         self._tracer = telemetry.tracer()
         eid = telemetry.instance_label()
         self.telemetry_label = eid
+        # -- per-request flight recorder + compile watching (ISSUE 12):
+        # both captured at construction like the registry/tracer, so an
+        # engine built under null mode stays zero-overhead for life.
+        # _flight_live holds in-flight records (rid -> dict); finished
+        # lifecycles move into the bounded FlightRecorder ring.
+        if flight_recorder is not None and int(flight_recorder) < 0:
+            raise ValueError(
+                f"flight_recorder={flight_recorder} < 0 — use 0/None "
+                f"to disable, or a positive record capacity"
+            )
+        fr_capacity = 0 if flight_recorder is None else int(flight_recorder)
+        self._flight = (
+            telemetry.FlightRecorder(fr_capacity)
+            if fr_capacity and not telemetry.null_mode() else None
+        )
+        self._flight_live: dict[int, dict] = {}
+        # jit-compile spans: each dispatch that grows a program's jit
+        # cache is recorded as a named "jit.compile" span, so a
+        # mid-serve recompile shows up ON the request timeline instead
+        # of being reconstructed by hand (the PR-9 light-tenant TTFT
+        # forensics). Off under null mode — the cache-size probe is
+        # cheap, but null means null.
+        self._trace_compiles = not telemetry.null_mode()
 
         allocator = None
         if self.paged:
@@ -700,8 +737,13 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             labels=("engine",),
         ).labels(engine=eid).set(self.arena.nbytes())
         if self.paged:
+            # named WITHOUT the _total suffix (ISSUE 12): OpenMetrics
+            # reserves _total for counters, and this is a gauge — a
+            # spec-strict scraper of the exemplar exposition would
+            # reject the whole page over it (was
+            # elephas_serving_blocks_total through PR 11)
             treg.gauge(
-                "elephas_serving_blocks_total",
+                "elephas_serving_kv_blocks",
                 "KV pool blocks in the paged arena",
                 labels=("engine",),
             ).labels(engine=eid).set(self.num_blocks)
@@ -1332,6 +1374,21 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             ttft_deadline_ms=ttft_deadline_ms,
         )
         req.submit_time = time.perf_counter()
+        # trace context minted HERE (ISSUE 12): the rid is the trace
+        # identity for every lifecycle event/record downstream (the
+        # gateway echoes it back as X-Request-Id and in the SSE/JSON
+        # envelopes)
+        req.submit_step = self.scheduler._steps
+        req.exemplar = {"rid": str(req.rid)}
+        rec = self._fr_new(req)
+        submit_seq = self._tracer.emit(
+            "serve.submit", rid=req.rid,
+            tenant=DEFAULT_TENANT if tenant is None else str(tenant),
+            prompt_tokens=p, max_new_tokens=int(max_new_tokens),
+            step=req.submit_step,
+        )
+        if rec is not None:
+            rec["submit_seq"] = submit_seq
         if self.paged:
             need = blocks_for(p + max_new_tokens, self.block_size)
             if need > self.num_blocks:
@@ -1350,6 +1407,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 self._m_rejected.inc()
                 self._tenant_child(self._mf_tenant_rejected, tenant).inc()
                 logger.warning("%s", req.error)
+                self._fr_finish(req, "rejected_capacity")
                 self.finished[req.rid] = req
                 self._evict_finished()
                 return req
@@ -1359,10 +1417,29 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             # request now — loudly, with a deterministic Retry-After —
             # instead of letting it time out at the back of a queue
             # that can only grow
+            tenant_debt = self.scheduler.queued_tokens_for(tenant)
             verdict = self.policy.admission_verdict(
-                req, self.scheduler.queued_tokens,
-                self.scheduler.queued_tokens_for(tenant),
+                req, self.scheduler.queued_tokens, tenant_debt,
             )
+            # verdict event + record (ISSUE 12): the fairness state
+            # the decision was made against rides along, so a trace
+            # answers "queued behind whose debt?" without replaying
+            # the policy
+            self._tracer.emit(
+                "serve.admission_verdict", rid=req.rid,
+                admitted=verdict.admitted, reason=verdict.reason,
+                queued_tokens=self.scheduler.queued_tokens,
+                tenant_queued_tokens=tenant_debt,
+            )
+            if rec is not None:
+                rec["verdict"] = {
+                    "admitted": verdict.admitted,
+                    "reason": verdict.reason,
+                    "retry_after_s": verdict.retry_after_s,
+                    "queued_tokens": self.scheduler.queued_tokens,
+                    "tenant_queued_tokens": tenant_debt,
+                    "virtual_counters": self.policy.snapshot_counters(),
+                }
             if not verdict.admitted:
                 req.error = AdmissionRejected(
                     f"request {req.rid} rejected by "
@@ -1374,6 +1451,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 self._m_admission_rejected.inc()
                 self._tenant_child(self._mf_tenant_rejected, tenant).inc()
                 logger.warning("%s", req.error)
+                self._fr_finish(req, "rejected_admission")
                 self.finished[req.rid] = req
                 self._evict_finished()
                 return req
@@ -1384,6 +1462,148 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         """The tenant-labeled child of ``family`` for this engine."""
         label = DEFAULT_TENANT if tenant is None else str(tenant)
         return family.labels(engine=self.telemetry_label, tenant=label)
+
+    # -- request-scoped tracing (ISSUE 12) ------------------------------
+
+    def _dispatch(self, program: str, fn, *args):
+        """Run one compiled-program dispatch; when the call grew the
+        program's jit cache (a compile happened inside it) record a
+        named ``jit.compile`` span covering the dispatch, so
+        mid-serve recompiles land on the same timeline as the request
+        lifecycle events. Watch-free (one function call) under null
+        mode; report-only always — nothing reads the cache size to
+        make a decision."""
+        if not self._trace_compiles:
+            return fn(*args)
+        try:
+            before = int(fn._cache_size())
+        except Exception:  # jax-version drift: dispatch unwatched
+            return fn(*args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        try:
+            grew = int(fn._cache_size()) > before
+        except Exception:  # jax-version drift mid-flight
+            grew = False
+        if grew:
+            self._tracer.complete(
+                "jit.compile", time.perf_counter() - t0,
+                program=program, engine=self.telemetry_label,
+            )
+        return out
+
+    def _fr(self, rid: int) -> dict | None:
+        """The request's lifecycle record — in-flight first, then the
+        finished ring (late entries like the spec round that ended the
+        request append there). None when recording is off or the
+        record was evicted."""
+        if self._flight is None:
+            return None
+        rec = self._flight_live.get(rid)
+        if rec is None:
+            rec = self._flight.get(rid)
+        return rec
+
+    def _fr_new(self, req: Request) -> dict | None:
+        """Open one in-flight lifecycle record at submit."""
+        if self._flight is None:
+            return None
+        rec = {
+            "rid": req.rid,
+            "tenant": req.tenant,
+            "prompt_tokens": len(req.prompt),
+            "max_new_tokens": req.max_new_tokens,
+            "temperature": req.temperature,
+            "priority": req.priority,
+            "ttft_deadline_ms": req.ttft_deadline_ms,
+            "submit_step": req.submit_step,
+            "submit_seq": -1,  # set from the serve.submit instant
+            "verdict": None,
+            # first-admission mirrors (the fields explain() names);
+            # `admissions` keeps every entry (resume re-admissions)
+            "admission_kind": None,
+            "reuse_len": 0,
+            "queue_wait_steps": None,
+            "admissions": [],
+            "chunks": [],
+            "sp_prefill": None,
+            "preemptions": [],
+            "resumes": [],
+            "spec_rounds": [],
+            "first_token": None,
+            "token_steps": [],
+            "tokens": 0,
+            "spec_drafted": 0,
+            "spec_accepted": 0,
+            "finish": None,
+        }
+        # every key is pre-seeded HERE and only ever re-assigned, so a
+        # lock-free reader (explain() without the engine lock) always
+        # sees a fixed-shape dict — deepcopy can never catch the dict
+        # growing mid-iteration
+        self._flight_live[req.rid] = rec
+        return rec
+
+    def _fr_finish(self, req: Request, reason: str) -> None:
+        """Close the request's record and file it in the bounded ring;
+        also emits the ``serve.finish`` lifecycle instant."""
+        seq = self._tracer.emit(
+            "serve.finish", rid=req.rid, reason=reason,
+            tokens=len(req.tokens), step=self.scheduler._steps,
+        )
+        if self._flight is None:
+            return
+        rec = self._flight_live.get(req.rid)
+        if rec is None:
+            return
+        rec["finish"] = {
+            "reason": reason,
+            "step": self.scheduler._steps,
+            "seq": seq,
+            "error": None if req.error is None else str(req.error),
+        }
+        rec["tokens"] = len(req.tokens)
+        rec["spec_drafted"] = req.spec_drafted
+        rec["spec_accepted"] = req.spec_accepted
+        # file into the ring BEFORE dropping the live entry: a
+        # lock-free explain() between the two stores must find the
+        # record in at least one of them (never a spurious KeyError
+        # for a request that exists)
+        self._flight.record(req.rid, rec)
+        self._flight_live.pop(req.rid, None)
+
+    def _trace_admissions(self, plan) -> None:
+        """One ``serve.admit`` instant + record entry per admission in
+        the wave: kind (cold / prefix_hit / resume), slot, reuse
+        length, and the queue wait in scheduler STEPS (logical — every
+        gang process reconstructs the identical number)."""
+        step = self.scheduler._steps
+        for a in plan:
+            if a.resume is not None:
+                kind, reuse = "resume", 0
+            elif a.donor_slot is not None or a.shared_len:
+                kind, reuse = "prefix_hit", (a.reuse_len or a.shared_len)
+            else:
+                kind, reuse = "cold", 0
+            req = a.req
+            wait = (
+                step - req.submit_step
+                if req.submit_step is not None else None
+            )
+            seq = self._tracer.emit(
+                "serve.admit", rid=req.rid, kind=kind, slot=a.slot,
+                reuse_len=reuse, step=step, queue_wait_steps=wait,
+            )
+            rec = self._fr(req.rid)
+            if rec is not None:
+                rec["admissions"].append({
+                    "kind": kind, "slot": a.slot, "reuse_len": reuse,
+                    "step": step, "seq": seq,
+                })
+                if rec["admission_kind"] is None:
+                    rec["admission_kind"] = kind
+                    rec["reuse_len"] = reuse
+                    rec["queue_wait_steps"] = wait
 
     def _emit(self, req: Request, token: int) -> bool:
         """Record one generated token; reclaim + file the request when
@@ -1397,12 +1617,29 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         slot = req.slot
         now = time.perf_counter()
         req.token_times.append(now)
+        rec = self._fr(req.rid) if self._flight is not None else None
+        if rec is not None:
+            rec["token_steps"].append(self.scheduler._steps)
         # latency histograms feed straight off the per-request arrival
-        # times stats() already reports — one recording site, no drift
+        # times stats() already reports — one recording site, no drift.
+        # Observations carry the rid as an exemplar (ISSUE 12): the
+        # OpenMetrics scrape links a p99 bucket straight to the trace
+        # of the request that landed in it.
         if len(req.token_times) == 1:
+            seq = self._tracer.emit(
+                "serve.first_token", rid=req.rid,
+                step=self.scheduler._steps,
+            )
             if req.submit_time is not None:
                 ttft = now - req.submit_time
-                self._m_ttft.observe(ttft)
+                self._m_ttft.observe(ttft, exemplar=req.exemplar)
+                if rec is not None:
+                    rec["first_token"] = {
+                        "step": self.scheduler._steps, "seq": seq,
+                        # wall-derived, EXPORT-ONLY (like every wall
+                        # field in the telemetry layer)
+                        "ttft_s": ttft,
+                    }
                 if req.ttft_deadline_ms is not None:
                     # SLO attainment (ISSUE 10): wall-clock TTFT meets
                     # the declared budget HERE and only here — report-
@@ -1413,7 +1650,9 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                         req.tenant,
                     ).inc()
         else:
-            self._m_itl.observe(now - req.token_times[-2])
+            self._m_itl.observe(
+                now - req.token_times[-2], exemplar=req.exemplar
+            )
         if self.policy is not None:
             self.policy.on_token(req)
             self._tenant_child(self._mf_tenant_tokens, req.tenant).inc()
@@ -1438,6 +1677,16 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 self.policy.on_finish(req)
             if self._spec_throttle is not None:
                 self._spec_throttle.forget(req.rid)
+            if req.error is not None:
+                reason = "callback_error"
+            elif (
+                req.eos_id is not None and req.tokens
+                and req.tokens[-1] == req.eos_id
+            ):
+                reason = "eos"
+            else:
+                reason = "budget"
+            self._fr_finish(req, reason)
             self.finished[req.rid] = req
             self._evict_finished()
         return done
@@ -1469,6 +1718,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 return  # every resident request is protected
             self.finished.pop(victim)
             self._m_finished_evicted.inc()
+            self._tracer.emit("serve.evict", rid=victim)
             self._evictions_seen += 1
             evicted = self._evictions_seen
             if evicted == 1 or evicted % 1024 == 0:
@@ -1551,7 +1801,8 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 admit[req.slot] = True
                 new_temps[req.slot] = req.temperature
             (self._caches, self._lengths, self._last, self._temps,
-             self._key, firsts) = self._prefill_jit(
+             self._key, firsts) = self._dispatch(
+                "prefill", self._prefill_jit,
                 self._weights, self._caches, self._lengths, self._last,
                 self._temps, self._stage_slots(rows),
                 self._stage_slots(p_lens), self._stage_slots(admit),
@@ -1565,6 +1816,20 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 self.scheduler.on_prefill_complete(req)
                 self._set_active(req.slot, True)
                 self._note_prefill(req, bucket)
+                seq = self._tracer.emit(
+                    "serve.prefill", rid=req.rid, bucket=bucket,
+                    prompt_tokens=len(req.prompt),
+                    step=self.scheduler._steps,
+                )
+                rec = self._fr(req.rid)
+                if rec is not None:
+                    # whole-prompt wave: one "chunk" covering it all,
+                    # so explain()'s chunk list is the prefill story
+                    # on every arena/config
+                    rec["chunks"].append({
+                        "offset": 0, "take": len(req.prompt),
+                        "step": self.scheduler._steps, "seq": seq,
+                    })
                 self._emit(req, int(toks[req.slot]))
 
     def _copy_vectors(self, copies):
@@ -1618,11 +1883,23 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             new_temps[slot] = req.temperature
             if done_prefill:
                 finalized.append(adm)
+            seq = self._tracer.emit(
+                "serve.prefill_chunk", rid=req.rid, offset=progress,
+                take=take, final=done_prefill,
+                step=self.scheduler._steps,
+            )
+            crec = self._fr(req.rid)
+            if crec is not None:
+                crec["chunks"].append({
+                    "offset": progress, "take": take,
+                    "step": self.scheduler._steps, "seq": seq,
+                })
         if self.paged:
             # paged chunk: the block tables carry the storage mapping
             # (incl. any spliced prefix blocks) — no copy vectors
             (self._caches, self._lengths, self._last, self._temps,
-             self._key, firsts) = self._paged_chunk_jit(
+             self._key, firsts) = self._dispatch(
+                "paged_chunk", self._paged_chunk_jit,
                 self._weights, self._caches, self._staged_tables(),
                 self._stage_slots(rows), self._stage_slots(offs),
                 self._stage_slots(clens), self._stage_slots(act),
@@ -1638,7 +1915,8 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 max(progress + take for _a, progress, take in items)
             ) if items else None
             (self._caches, self._lengths, self._last, self._temps,
-             self._key, firsts) = self._chunk_jit(
+             self._key, firsts) = self._dispatch(
+                "chunk_prefill", self._chunk_jit,
                 self._weights, self._caches, self._lengths, self._last,
                 self._temps, self._stage_slots(rows),
                 self._stage_slots(offs), self._stage_slots(clens),
@@ -1706,10 +1984,20 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         dependency keeps it ordered before any donating consumer)."""
         req = pre.req
         with self._tracer.span(
-            "serve.preempt", req=req.rid, blocks=len(pre.blocks),
-        ):
+            "serve.preempt", rid=req.rid, blocks=len(pre.blocks),
+        ) as sp:
+            rec = self._fr(req.rid)
+            if rec is not None:
+                rec["preemptions"].append({
+                    "blocks": len(pre.blocks), "cur_len": pre.cur_len,
+                    "step": self.scheduler._steps,
+                    "seq": sp.begin_seq,
+                })
             ids = self._pad_ids(pre.blocks)
-            rows = self._gather_jit(self._caches, self._stage(ids))
+            rows = self._dispatch(
+                "offload_gather", self._gather_jit,
+                self._caches, self._stage(ids),
+            )
             n = len(pre.blocks)
             host = {
                 name: (
@@ -1738,8 +2026,15 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         req = adm.req
         store = self._offloaded.pop(req.rid)
         with self._tracer.span(
-            "serve.resume", req=req.rid, blocks=store.n_blocks,
-        ):
+            "serve.resume", rid=req.rid, blocks=store.n_blocks,
+        ) as sp:
+            rec = self._fr(req.rid)
+            if rec is not None:
+                rec["resumes"].append({
+                    "blocks": store.n_blocks, "cur_len": store.cur_len,
+                    "step": self.scheduler._steps,
+                    "seq": sp.begin_seq,
+                })
             n = store.n_blocks
             ids = self._pad_ids(adm.blocks[:n])
             Tb = len(ids)
@@ -1749,8 +2044,9 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 pv = np.zeros((Tb,) + hv.shape[1:], hv.dtype)
                 pk[:n], pv[:n] = hk, hv
                 rows[name] = (self._stage(pk), self._stage(pv))
-            self._caches = self._scatter_jit(
-                self._caches, self._stage(ids), rows
+            self._caches = self._dispatch(
+                "resume_scatter", self._scatter_jit,
+                self._caches, self._stage(ids), rows,
             )
             mask = np.zeros((self.num_slots,), bool)
             mask[adm.slot] = True
@@ -1760,13 +2056,12 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             r_last[adm.slot] = req.tokens[-1]
             r_temps = np.zeros((self.num_slots,), np.float32)
             r_temps[adm.slot] = req.temperature
-            self._lengths, self._last, self._temps = (
-                self._resume_state_jit(
-                    self._lengths, self._last, self._temps,
-                    self._stage_slots(mask), self._stage_slots(r_len),
-                    self._stage_slots(r_last),
-                    self._stage_slots(r_temps),
-                )
+            self._lengths, self._last, self._temps = self._dispatch(
+                "resume_state", self._resume_state_jit,
+                self._lengths, self._last, self._temps,
+                self._stage_slots(mask), self._stage_slots(r_len),
+                self._stage_slots(r_last),
+                self._stage_slots(r_temps),
             )
         self._set_active(adm.slot, True)
         self._m_resumes.inc()
@@ -1838,10 +2133,19 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         Tb = len(ids)
         bs = self.block_size
         with self._tracer.span(
-            "serve.sp_prefill", req=req.rid, prompt=p, padded=S,
+            "serve.sp_prefill", rid=req.rid, prompt=p, padded=S,
             shards=int(sp_w), mechanism=self.sp_mechanism,
-        ):
-            kv, row = self._sp_jit(
+        ) as sp:
+            rec = self._fr(req.rid)
+            if rec is not None:
+                rec["sp_prefill"] = {
+                    "padded": int(S), "shards": int(sp_w),
+                    "mechanism": self.sp_mechanism,
+                    "step": self.scheduler._steps,
+                    "seq": sp.begin_seq,
+                }
+            kv, row = self._dispatch(
+                "sp_prefill", self._sp_jit,
                 self._sp_staged_weights(), jnp.asarray(tokens),
                 np.int32(p),
             )
@@ -1870,10 +2174,12 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                     self._stage(hk.reshape(Tb, bs, *hk.shape[1:])),
                     self._stage(hv.reshape(Tb, bs, *hv.shape[1:])),
                 )
-            self._caches = self._scatter_jit(
-                self._caches, self._stage(ids), rows
+            self._caches = self._dispatch(
+                "resume_scatter", self._scatter_jit,
+                self._caches, self._stage(ids), rows,
             )
-            tok_dev, self._key = self._sp_sample_jit(
+            tok_dev, self._key = self._dispatch(
+                "sp_sample", self._sp_sample_jit,
                 self._stage(np.asarray(row)),
                 jnp.full((1,), req.temperature, jnp.float32),
                 self._key,
@@ -1887,13 +2193,12 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             r_last[a.slot] = tok
             r_temps = np.zeros((self.num_slots,), np.float32)
             r_temps[a.slot] = req.temperature
-            self._lengths, self._last, self._temps = (
-                self._resume_state_jit(
-                    self._lengths, self._last, self._temps,
-                    self._stage_slots(mask), self._stage_slots(r_len),
-                    self._stage_slots(r_last),
-                    self._stage_slots(r_temps),
-                )
+            self._lengths, self._last, self._temps = self._dispatch(
+                "resume_state", self._resume_state_jit,
+                self._lengths, self._last, self._temps,
+                self._stage_slots(mask), self._stage_slots(r_len),
+                self._stage_slots(r_last),
+                self._stage_slots(r_temps),
             )
         self.scheduler.on_prefill_complete(req)
         self._set_active(a.slot, True)
@@ -1960,7 +2265,8 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         if self.prefill_chunk:
             if copies:
                 src, mask, clen = self._copy_vectors(copies)
-                self._caches = self._copy_jit(
+                self._caches = self._dispatch(
+                    "prefix_copy", self._copy_jit,
                     self._caches, self._stage_slots(src),
                     self._stage_slots(mask), self._stage_slots(clen),
                 )
@@ -2069,6 +2375,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 self._offload(pre)
             if plan:
                 self._note_admissions(plan)
+                self._trace_admissions(plan)
                 emitted.extend(self._admit_wave_paged(plan))
         else:
             plan = self.scheduler.admit()
@@ -2076,6 +2383,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 # admission emissions land before any decode token, so
                 # req.done there is the prefill token's own flag
                 self._note_admissions(plan)
+                self._trace_admissions(plan)
                 emitted.extend(self._admit_wave(plan))
         emitted.extend(self._prefill_progress())
         if not any(
@@ -2102,14 +2410,16 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         ):
             if self.paged:
                 (self._caches, self._lengths, self._last, self._key,
-                 window) = self._paged_decode_jit(
+                 window) = self._dispatch(
+                    "paged_decode", self._paged_decode_jit,
                     self._weights, self._caches, self._staged_tables(),
                     self._lengths, self._last, self._temps,
                     self._sync_active(), self._key,
                 )
             else:
                 (self._caches, self._lengths, self._last, self._key,
-                 window) = self._decode_jit(
+                 window) = self._dispatch(
+                    "decode", self._decode_jit,
                     self._weights, self._caches, self._lengths,
                     self._last, self._temps, self._sync_active(),
                     self._key, self._decode_span(),
@@ -2221,7 +2531,8 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             k=self.spec_k,
         ) as span:
             if self.paged:
-                self._caches, self._key, sampled = self._verify_jit(
+                self._caches, self._key, sampled = self._dispatch(
+                    "spec_verify", self._verify_jit,
                     self._weights, self._caches, self._staged_tables(),
                     self._stage_slots(packed), self._temps, self._key,
                 )
@@ -2232,7 +2543,8 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                     int(packed[s, W]) + int(packed[s, W + 1])
                     for s, _r, _d in verifying
                 )) if verifying else None
-                self._caches, self._key, sampled = self._verify_jit(
+                self._caches, self._key, sampled = self._dispatch(
+                    "spec_verify", self._verify_jit,
                     self._weights, self._caches,
                     self._stage_slots(packed), self._temps, self._key,
                     att_span,
@@ -2262,8 +2574,33 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 accepted_total += a
                 req.spec_drafted += len(drafts)
                 req.spec_accepted += a
-                if self._spec_throttle.note(req.rid, len(drafts), a):
+                tripped = self._spec_throttle.note(
+                    req.rid, len(drafts), a
+                )
+                if tripped:
                     self._m_spec_throttled.inc()
+                seq = self._tracer.emit(
+                    "serve.spec_verify", rid=req.rid,
+                    drafted=len(drafts), accepted=a,
+                    throttled=self._spec_throttle.throttled(req.rid),
+                    step=self.scheduler._steps,
+                )
+                rec = self._fr(req.rid)
+                if rec is not None:
+                    rec["spec_rounds"].append({
+                        "drafted": len(drafts), "accepted": a,
+                        "throttled": self._spec_throttle.throttled(
+                            req.rid
+                        ),
+                        "step": self.scheduler._steps, "seq": seq,
+                    })
+                    # a request that FINISHED inside this round was
+                    # filed by _fr_finish before these per-round
+                    # increments landed — refresh the totals so the
+                    # record always agrees with its own spec_rounds
+                    # (same dict object whether live or filed)
+                    rec["spec_drafted"] = req.spec_drafted
+                    rec["spec_accepted"] = req.spec_accepted
             span.set(accepted=accepted_total)
         self._m_spec_drafted.inc(drafted)
         self._m_spec_accepted.inc(accepted_total)
@@ -2331,12 +2668,129 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
     def finished_evicted(self) -> int:
         return int(self._m_finished_evicted.value)
 
-    def scrape(self) -> str:
+    def scrape(self, openmetrics: bool = False) -> str:
         """This engine's registry rendered as Prometheus exposition
         text (the in-process scrape surface; the HTTP surface is the
         parameter server's ``GET /metrics``). Empty when the engine was
-        constructed under telemetry null mode."""
+        constructed under telemetry null mode. ``openmetrics=True``
+        renders the OpenMetrics flavor instead — histogram buckets
+        carry their rid exemplars (ISSUE 12), so a TTFT p99 spike
+        links straight to :meth:`explain`'s record of the request."""
+        if openmetrics:
+            return telemetry.render_openmetrics(self._telemetry_registry)
         return telemetry.render(self._telemetry_registry)
+
+    def prefix_warm_probe(self, prompt) -> int:
+        """How many leading tokens of ``prompt`` the engine's prefix
+        cache would serve without recompute — the pure cache-warmth
+        probe (ISSUE 12 satellite; ROADMAP item 3's cache-aware-
+        routing primitive). 0 on engines without a prefix cache. Pure
+        and side-effect-free (no hit/LRU accounting, same contract as
+        ``match()``), so probing at any rate never skews this
+        engine's cache behavior, and by construction it equals the
+        reuse length admission would then commit. NOT synchronized
+        against a concurrently-stepping driver — on a gateway-driven
+        engine, probe while holding the gateway's engine lock (the
+        wire surfaces already do)."""
+        prompt = np.asarray(prompt).reshape(-1)
+        idx = self.scheduler.prefix_index
+        if idx is not None:
+            return idx.match_len(prompt)
+        cache = self.scheduler.prefix_cache
+        if cache is not None:
+            return cache.match_len(prompt)
+        return 0
+
+    def explain(self, rid: int) -> dict:
+        """The structured lifecycle record of one request (ISSUE 12):
+        admission verdict + queue wait, admission kind/reuse length,
+        prefill chunks, preempt/offload/resume, spec verify rounds,
+        per-token step indices, first token, and finish reason — every
+        entry stamped with the scheduler step and tracer sequence
+        number it happened at (logical order; wall-derived fields are
+        export-only). In-flight requests return their partial record
+        (``finish`` is None); finished requests come from the bounded
+        flight-recorder ring (last ``flight_recorder=`` lifecycles).
+
+        Raises ``RuntimeError`` when the recorder is off (knob 0/None
+        or the engine was built under telemetry null mode) and
+        ``KeyError`` for an unknown/evicted rid. Served over the wire
+        as ``GET /v1/requests/{rid}/trace``."""
+        import copy
+
+        if self._flight is None:
+            raise RuntimeError(
+                "flight recorder is off (flight_recorder=0/None, or "
+                "the engine was built under telemetry null mode) — "
+                "explain() has no lifecycle records to read"
+            )
+        rec = self._fr(int(rid))
+        if rec is None:
+            raise KeyError(
+                f"no lifecycle record for request {rid} — never "
+                f"submitted to this engine, or evicted from the "
+                f"{self._flight.capacity}-record flight ring"
+            )
+        return copy.deepcopy(rec)
+
+    def debug_snapshot(self) -> dict:
+        """One structured snapshot of live engine state (ISSUE 12 —
+        the gateway's ``GET /debug/engine``): slot map, waiting queue
+        with per-request policy debt, block-pool occupancy, offloaded
+        (preempted) requests, prefix cache/index summary, policy
+        state (virtual counters), compiled-program counts, and the
+        flight recorder's occupancy. Read-only host work — safe to
+        call between steps at any cadence."""
+        sched = self.scheduler
+        slots = {}
+        for slot, req in sorted(sched.active.items()):
+            pre = self._prefilling.get(slot)
+            slots[str(slot)] = {
+                "rid": req.rid,
+                "tenant": req.tenant,
+                "prompt_tokens": len(req.prompt),
+                "generated": len(req.tokens),
+                "prefilling": pre is not None,
+                "prefill_progress": pre[1] if pre is not None else None,
+                "table_blocks": (
+                    len(sched.tables.get(slot, ()))
+                    if self.paged else None
+                ),
+            }
+        out = {
+            "engine": self.telemetry_label,
+            "steps": sched._steps,
+            "num_slots": self.num_slots,
+            "attention": self.attention,
+            "slots": slots,
+            "waiting": sched.queue_snapshot(),
+            "queued_tokens": sched.queued_tokens,
+            "offloaded": {
+                str(rid): {"blocks": r.n_blocks, "cur_len": r.cur_len}
+                for rid, r in sorted(self._offloaded.items())
+            },
+            "policy": (
+                self.policy.stats() if self.policy is not None else None
+            ),
+            "compile_stats": self.compile_stats(),
+            "flight_recorder": (
+                None if self._flight is None else {
+                    "capacity": self._flight.capacity,
+                    "finished_resident": len(self._flight),
+                    "in_flight": len(self._flight_live),
+                }
+            ),
+        }
+        if self.paged:
+            out["blocks_total"] = self.num_blocks
+            out["blocks_free"] = self.scheduler.allocator.free_count
+            idx = sched.prefix_index
+            out["prefix_index"] = (
+                idx.stats() if idx is not None else None
+            )
+        elif sched.prefix_cache is not None:
+            out["prefix_cache"] = sched.prefix_cache.stats()
+        return out
 
     def release_telemetry(self) -> None:
         """Retire this engine's labeled series — its own, its

@@ -14,9 +14,25 @@ notebook, useless for the "millions of users" north star. The
   opening ``data: {"rid": id}`` event, then the connection closes.
   ``stream: false`` buffers and returns one JSON document.
 - ``GET /metrics`` — the process registry through the PR-5 Prometheus
-  renderer (the same text an in-process ``engine.scrape()`` returns).
+  renderer (the same text an in-process ``engine.scrape()`` returns);
+  an ``Accept: application/openmetrics-text`` client gets the
+  OpenMetrics flavor with rid-stamped histogram exemplars (ISSUE 12).
 - ``GET /stats`` — ``engine.stats()`` as JSON (per-tenant SLO section
   included).
+- ``GET /healthz`` — cheap liveness for a fleet router (ISSUE 12):
+  200 while the driver thread is alive and steps advance when there
+  is work, 503 otherwise. Never waits on the engine lock.
+- ``GET /v1/requests/{rid}/trace`` — the flight-recorder lifecycle
+  record of one request (``engine.explain(rid)`` on the wire).
+- ``GET /debug/engine`` — ``engine.debug_snapshot()`` as JSON: slot
+  map, waiting queue with policy debt, block-pool occupancy, prefix
+  index summary, compile stats.
+
+Every ``/v1/generate`` response — SSE, buffered JSON, and the 429/422
+rejects alike — echoes the engine-minted request id as an
+``X-Request-Id`` header (and in the SSE opening event / JSON body),
+so a client, proxy log, or exemplar-following dashboard can join any
+response to its trace.
 
 Backpressure is the policy's admission verdict on the wire: a submit
 refused by overload admission control returns **429** with a
@@ -50,7 +66,9 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import re
 import threading
+import time
 
 from elephas_tpu import telemetry
 from elephas_tpu.serving.policy import AdmissionRejected
@@ -70,7 +88,7 @@ _STATUS = {
     405: "Method Not Allowed", 408: "Request Timeout",
     413: "Payload Too Large", 422: "Unprocessable Entity",
     429: "Too Many Requests", 500: "Internal Server Error",
-    503: "Service Unavailable",
+    501: "Not Implemented", 503: "Service Unavailable",
 }
 
 
@@ -110,13 +128,26 @@ class Gateway:
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  read_timeout: float = READ_TIMEOUT,
-                 max_body: int = MAX_BODY):
+                 max_body: int = MAX_BODY,
+                 health_stall_grace: float = 120.0):
         self.engine = engine
         self.host = host
         self._want_port = int(port)
         self.port: int | None = None
         self.read_timeout = float(read_timeout)
         self.max_body = int(max_body)
+        # /healthz stall detection (ISSUE 12): grace window before
+        # "has work but steps are not advancing" reports 503. A
+        # first-request XLA compile legitimately freezes steps for a
+        # while, so the default is generous (2 min); size the knob to
+        # your model's cold-start compile time — a router probing a
+        # large model with a tight grace WILL false-positive during
+        # warmup
+        self.health_stall_grace = float(health_stall_grace)
+        # (steps, monotonic-time) of the last observed step progress;
+        # time.monotonic is a LOCAL duration clock — wall clock stays
+        # banned on serving control paths (telemetry lint)
+        self._hz_anchor: tuple[int, float] | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._server: asyncio.AbstractServer | None = None
         self._loop_thread: threading.Thread | None = None
@@ -310,13 +341,13 @@ class Gateway:
                 # ONE deadline over the whole request read: the
                 # per-line timeouts inside cannot bound a client that
                 # dribbles a header every few seconds forever
-                method, path, body = await asyncio.wait_for(
+                method, path, body, headers = await asyncio.wait_for(
                     self._read_request(reader), self.read_timeout
                 )
                 route = self._route_label(method, path)
                 with self._tracer.span("gateway.request", route=route):
                     code = await self._route(
-                        method, path, body, writer
+                        method, path, body, headers, writer
                     )
             except _HttpError as e:
                 code = e.code
@@ -348,16 +379,24 @@ class Gateway:
             except OSError:
                 pass  # fault-lint: allow — already-severed transport
 
-    @staticmethod
-    def _route_label(method: str, path: str) -> str:
+    _TRACE_PATH = re.compile(r"^/v1/requests/(\d+)/trace$")
+
+    @classmethod
+    def _route_label(cls, method: str, path: str) -> str:
         """Metric label for the route — KNOWN (method, path) pairs
         only, everything else collapses to "other": no part of the
         label value may be client-controlled, or a scanner walking
         paths (or inventing METHOD tokens on real paths) mints
-        unbounded registry series."""
-        route = f"{method} {path.split('?', 1)[0]}"
+        unbounded registry series. The per-request trace route
+        collapses its rid into the ``:rid`` template for the same
+        reason."""
+        bare = path.split("?", 1)[0]
+        if method == "GET" and cls._TRACE_PATH.match(bare):
+            return "GET /v1/requests/:rid/trace"
+        route = f"{method} {bare}"
         if route in (
             "POST /v1/generate", "GET /metrics", "GET /stats",
+            "GET /healthz", "GET /debug/engine",
         ):
             return route
         return "other"
@@ -398,7 +437,7 @@ class Gateway:
                 )
             if n:
                 body = await reader.readexactly(n)
-        return method, path, body
+        return method, path, body, headers
 
     async def _write(self, writer, data: bytes) -> None:
         # sockets.py lesson: sendall/drain after every write — a slow
@@ -406,7 +445,7 @@ class Gateway:
         writer.write(data)
         await writer.drain()
 
-    async def _route(self, method, path, body, writer) -> int:
+    async def _route(self, method, path, body, headers, writer) -> int:
         path = path.split("?", 1)[0]
         if path == "/v1/generate":
             if method != "POST":
@@ -415,30 +454,118 @@ class Gateway:
         if path == "/metrics":
             if method != "GET":
                 raise _HttpError(405, "GET only")
-            text = telemetry.render().encode("utf-8")
-            await self._write(writer, _response(
-                200, text, "text/plain; version=0.0.4; charset=utf-8"
-            ))
+            # content negotiation (ISSUE 12): an OpenMetrics-aware
+            # scraper gets histogram exemplars (rid-stamped TTFT/ITL
+            # observations); the 0.0.4 default stays exemplar-free
+            # because its parsers reject a '#' after the value
+            if _wants_openmetrics(headers.get("accept", "")):
+                text = telemetry.render_openmetrics().encode("utf-8")
+                ctype = telemetry.CONTENT_TYPE_OPENMETRICS
+            else:
+                text = telemetry.render().encode("utf-8")
+                ctype = telemetry.CONTENT_TYPE
+            await self._write(writer, _response(200, text, ctype))
             return 200
         if path == "/stats":
             if method != "GET":
                 raise _HttpError(405, "GET only")
-            loop = asyncio.get_running_loop()
-
-            def snapshot():
-                # off-loop: the lock may be held by a long engine step
-                # and must not freeze the event loop while we wait
-                with self._engine_lock:
-                    return json.dumps(
-                        self.engine.stats(), default=float
-                    ).encode("utf-8") + b"\n"
-
-            body = await loop.run_in_executor(None, snapshot)
-            await self._write(writer, _response(
-                200, body, "application/json"
-            ))
-            return 200
+            return await self._json_snapshot(
+                writer, lambda: self.engine.stats()
+            )
+        if path == "/healthz":
+            if method != "GET":
+                raise _HttpError(405, "GET only")
+            return await self._healthz(writer)
+        if path == "/debug/engine":
+            if method != "GET":
+                raise _HttpError(405, "GET only")
+            return await self._json_snapshot(
+                writer, lambda: self.engine.debug_snapshot()
+            )
+        m = self._TRACE_PATH.match(path)
+        if m is not None:
+            if method != "GET":
+                raise _HttpError(405, "GET only")
+            return await self._request_trace(int(m.group(1)), writer)
         raise _HttpError(404, f"no route {path}")
+
+    async def _json_snapshot(self, writer, fn) -> int:
+        """Serve ``fn()`` (engine introspection under the engine lock)
+        as one JSON document, computed off-loop: the lock may be held
+        by a long engine step and must not freeze the event loop."""
+        loop = asyncio.get_running_loop()
+
+        def snapshot():
+            with self._engine_lock:
+                return json.dumps(
+                    fn(), default=float
+                ).encode("utf-8") + b"\n"
+
+        body = await loop.run_in_executor(None, snapshot)
+        await self._write(writer, _response(
+            200, body, "application/json"
+        ))
+        return 200
+
+    async def _request_trace(self, rid: int, writer) -> int:
+        """``GET /v1/requests/{rid}/trace`` — the engine's flight-
+        recorder record for one request (ISSUE 12). 404 for an
+        unknown/evicted rid, 501 when the recorder is off (retrying
+        cannot help; the engine must be rebuilt with
+        ``flight_recorder=N``)."""
+        loop = asyncio.get_running_loop()
+
+        def lookup():
+            with self._engine_lock:
+                return self.engine.explain(rid)
+
+        try:
+            record = await loop.run_in_executor(None, lookup)
+        except KeyError as e:
+            raise _HttpError(404, str(e).strip("'\""))
+        except RuntimeError as e:
+            raise _HttpError(501, str(e))
+        await self._write(writer, _json_response(
+            200, record, extra_headers=(("X-Request-Id", str(rid)),)
+        ))
+        return 200
+
+    async def _healthz(self, writer) -> int:
+        """Cheap liveness for the fleet router (ISSUE 12 satellite):
+        200 when the engine driver thread is alive, the gateway is not
+        stopping, and — when there is work — steps are advancing;
+        answering at all proves the event loop responsive. Reads a
+        couple of ints without the engine lock (GIL-atomic loads): a
+        health probe must never queue behind a long step."""
+        driver = self._driver_thread
+        alive = (
+            driver is not None and driver.is_alive()
+            and not self._stopping.is_set()
+        )
+        sched = self.engine.scheduler
+        steps = sched._steps
+        has_work = sched.has_work
+        now = time.monotonic()
+        anchor = self._hz_anchor
+        if not has_work or anchor is None or anchor[0] != steps:
+            self._hz_anchor = anchor = (steps, now)
+        stalled = (
+            has_work and now - anchor[1] > self.health_stall_grace
+        )
+        status = (
+            "driver-dead" if not alive
+            else "stalled" if stalled else "ok"
+        )
+        body = {
+            "status": status,
+            "steps": steps,
+            "queue_has_work": has_work,
+            "driver_alive": alive,
+        }
+        await self._write(writer, _json_response(
+            200 if status == "ok" else 503, body
+        ))
+        return 200 if status == "ok" else 503
 
     def _parse_generate(self, body: bytes) -> dict:
         try:
@@ -492,16 +619,20 @@ class Gateway:
         except (ValueError, TypeError) as e:
             raise _HttpError(400, str(e))
         if req.error is not None:
-            # rejected at submit — backpressure on the wire
+            # rejected at submit — backpressure on the wire. The rid
+            # still echoes (ISSUE 12): the rejection has a flight
+            # record too, and the client can fetch its trace.
+            rid_hdr = ("X-Request-Id", str(req.rid))
             if isinstance(req.error, AdmissionRejected):
                 raise _HttpError(
                     429, str(req.error),
                     extra_headers=(
                         ("Retry-After",
                          str(max(1, round(req.error.retry_after_s)))),
+                        rid_hdr,
                     ),
                 )
-            raise _HttpError(422, str(req.error))
+            raise _HttpError(422, str(req.error), extra_headers=(rid_hdr,))
         self._work.set()  # wake the driver
         if stream:
             return await self._stream_sse(req, q, writer)
@@ -523,14 +654,21 @@ class Gateway:
             "full_sequence": list(req.prompt) + list(req.tokens),
             "error": None if req.error is None else str(req.error),
         }
-        await self._write(writer, _json_response(200, payload))
+        await self._write(writer, _json_response(
+            200, payload,
+            extra_headers=(("X-Request-Id", str(req.rid)),),
+        ))
         return 200
 
     async def _stream_sse(self, req, q, writer) -> int:
+        # trace-context echo on the wire (ISSUE 12): the engine-minted
+        # rid rides a header (greppable by proxies) AND the opening
+        # data event (greppable by SSE consumers)
         head = (
             b"HTTP/1.1 200 OK\r\n"
             b"Content-Type: text/event-stream\r\n"
             b"Cache-Control: no-cache\r\n"
+            b"X-Request-Id: " + str(req.rid).encode("ascii") + b"\r\n"
             b"Connection: close\r\n\r\n"
         )
         self._m_sse_active.inc()
@@ -561,6 +699,35 @@ class Gateway:
         finally:
             self._m_sse_active.dec()
         return 200
+
+
+def _wants_openmetrics(accept: str) -> bool:
+    """Does this ``Accept`` header prefer the OpenMetrics exposition?
+    Media types compare case-insensitively (RFC 9110) and q-values are
+    honored, so ``application/openmetrics-text;q=0.1, text/plain``
+    stays on 0.0.4 while ``Application/OpenMetrics-Text`` gets
+    exemplars — a substring test got both wrong."""
+
+    def _q(params) -> float:
+        for p in params:
+            k, _, v = p.partition("=")
+            if k.strip() == "q":
+                try:
+                    return float(v.strip())
+                except ValueError:
+                    return 0.0
+        return 1.0
+
+    om_q, plain_q = 0.0, 0.0
+    for media_range in accept.lower().split(","):
+        mtype, *params = media_range.split(";")
+        mtype = mtype.strip()
+        q = _q(params)
+        if mtype == "application/openmetrics-text":
+            om_q = max(om_q, q)
+        elif mtype in ("text/plain", "text/*", "*/*"):
+            plain_q = max(plain_q, q)
+    return om_q > 0.0 and om_q >= plain_q
 
 
 def _sse_event(obj, event: str | None = None) -> bytes:

@@ -188,6 +188,14 @@ class Policy:
         """Policy-internal state for ``engine.stats()['policy']``."""
         return {"name": type(self).__name__}
 
+    def snapshot_counters(self) -> dict:
+        """Per-tenant virtual-counter snapshot for the flight record's
+        admission-verdict entry (ISSUE 12): the fairness state the
+        verdict was decided against, so ``explain(rid)`` can answer
+        "queued behind whose debt?". Cheap and read-only — policies
+        without counters return ``{}``."""
+        return {}
+
 
 class FifoPolicy(Policy):
     """Submission order, admit everything — the legacy behavior as an
@@ -409,6 +417,9 @@ class FairSharePolicy(Policy):
         return req.priority + boost
 
     # -- introspection --------------------------------------------------
+
+    def snapshot_counters(self) -> dict:
+        return {t: round(v, 3) for t, v in sorted(self._vtc.items())}
 
     def stats(self) -> dict:
         return {

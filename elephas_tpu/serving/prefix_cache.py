@@ -219,6 +219,19 @@ class PrefixCache:
         )
         return slot, best_depth
 
+    def match_len(self, prompt) -> int:
+        """How many leading tokens of ``prompt`` an admission would
+        reuse from this cache — the cache-warmth probe (ISSUE 12
+        satellite, the ROADMAP fleet router's cache-aware-placement
+        primitive). PURE like :meth:`match` (no hit/LRU/pin mutation,
+        probe at any rate without skewing stats or eviction order) and
+        by construction identical to ``match(prompt)[1]``, so a
+        router's placement estimate can never disagree with what
+        admission then does. Purity is not thread-safety: the trie is
+        mutated by the thread driving admission, so serialize probes
+        with it (the gateway's engine lock is that serialization)."""
+        return self.match(prompt)[1]
+
     def pin(self, slot: int) -> None:
         """Block eviction of the entry while a wave holds it."""
         self._entries[slot].pins += 1
@@ -483,6 +496,13 @@ class PagedPrefixIndex:
             key=lambda e: (self._entries[e].last_use, -e),
         )
         return eid, best_depth
+
+    def match_len(self, prompt) -> int:
+        """Reusable full-block prefix length for ``prompt`` — the pure
+        cache-warmth probe (ISSUE 12 satellite), identical to
+        ``match(prompt)[1]`` by construction; see
+        :meth:`PrefixCache.match_len`."""
+        return self.match(prompt)[1]
 
     def commit_hit(self, eid: int, reuse_len: int) -> list[int]:
         """The admission lands: reference the entry's first

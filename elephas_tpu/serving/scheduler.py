@@ -97,6 +97,11 @@ class Request:
     slot: int | None = None
     done: bool = False
     emitted: int = 0
+    # logical submit stamp (ISSUE 12): the scheduler step count at
+    # submit — queue-wait in the flight record is measured in STEPS
+    # (admit_step - submit_step), never wall time, so every gang
+    # process reconstructs the identical lifecycle
+    submit_step: int | None = None
     submit_time: float | None = None
     finish_time: float | None = None
     on_token: object | None = None
@@ -113,6 +118,10 @@ class Request:
     # (the acceptance throttle reads its own windowed state, not these)
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # exemplar label set (ISSUE 12): built ONCE at submit and reused
+    # for every TTFT/ITL observation of this request — the per-token
+    # hot path must not allocate a dict + str per observation
+    exemplar: dict | None = None
 
     @property
     def full_sequence(self) -> list:
@@ -683,6 +692,26 @@ class Scheduler:
         if self._steps == 0:
             return 0.0
         return self._busy_slot_steps / (self._steps * self.num_slots)
+
+    def queue_snapshot(self) -> list[dict]:
+        """The waiting queue as structured rows (ISSUE 12 — the
+        ``GET /debug/engine`` snapshot's queue section): rid, tenant,
+        outstanding token debt, priority, deadline class, and whether
+        the entry is a preempted request awaiting resume. Read-only
+        host work; order is the queue's current (policy-ranked)
+        order."""
+        return [
+            {
+                "rid": r.rid,
+                "tenant": r.tenant,
+                "priority": r.priority,
+                "debt_tokens": self._debt(r),
+                "prompt_tokens": len(r.prompt),
+                "ttft_deadline_ms": r.ttft_deadline_ms,
+                "preempted": r.rid in self._preempted,
+            }
+            for r in self.waiting
+        ]
 
     def bucket_for(self, prompt_len: int) -> int:
         return bucket_for(prompt_len, self.buckets)

@@ -1208,6 +1208,7 @@ class SparkModel:
         gateway_port: int | None = None,
         gateway_host: str = "127.0.0.1",
         attention: str = "flash",
+        flight_recorder: int | None = 256,
     ):
         """A continuous-batching :class:`~elephas_tpu.serving.engine.\
 InferenceEngine` over this wrapper's mesh — the serving analogue of
@@ -1258,10 +1259,16 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
         accounting, or pass a :class:`~elephas_tpu.serving.policy.\
 Policy` instance. ``gateway_port=`` (0 = ephemeral) additionally
         starts the async HTTP/SSE front door on the engine
-        (``POST /v1/generate``, ``GET /metrics``, ``GET /stats``; see
+        (``POST /v1/generate``, ``GET /metrics``, ``GET /stats``,
+        ``GET /healthz``, ``GET /v1/requests/{rid}/trace``,
+        ``GET /debug/engine``; see
         :mod:`elephas_tpu.serving.gateway`). The returned engine is a
         context manager: leaving the ``with`` block stops the gateway,
         severs live SSE connections, and releases the port.
+
+        ``flight_recorder=`` (ISSUE 12) sizes the per-request flight
+        recorder behind ``engine.explain(rid)`` and the gateway trace
+        route — the last N finished request lifecycles (0/None off).
         """
         from elephas_tpu.serving import InferenceEngine
         from elephas_tpu.serving.policy import resolve_policy
@@ -1298,6 +1305,7 @@ Policy` instance. ``gateway_port=`` (0 = ephemeral) additionally
             spec_drafter=spec_drafter,
             policy=resolve_policy(policy, tenants),
             attention=attention,
+            flight_recorder=flight_recorder,
         )
         if gateway_port is not None:
             from elephas_tpu.serving.gateway import Gateway

@@ -42,9 +42,12 @@ from elephas_tpu.telemetry.events import (  # noqa: F401
 )
 from elephas_tpu.telemetry.expose import (  # noqa: F401
     CONTENT_TYPE,
+    CONTENT_TYPE_OPENMETRICS,
     render,
+    render_openmetrics,
     scrape_text,
 )
+from elephas_tpu.telemetry.flight import FlightRecorder  # noqa: F401
 from elephas_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_TIME_BUCKETS,
     NULL_METRIC,
@@ -63,9 +66,12 @@ __all__ = [
     "NullRegistry",
     "EventTracer",
     "NullTracer",
+    "FlightRecorder",
     "DEFAULT_TIME_BUCKETS",
     "NULL_METRIC",
     "CONTENT_TYPE",
+    "CONTENT_TYPE_OPENMETRICS",
+    "render_openmetrics",
     "registry",
     "default_registry",
     "instance_label",

@@ -61,6 +61,12 @@ class _Span:
         self._t0 = time.time()
         return self
 
+    @property
+    def begin_seq(self) -> int:
+        """The span's begin sequence number (valid after ``__enter__``)
+        — flight-recorder entries correlate on it (ISSUE 12)."""
+        return self._seq0
+
     def set(self, **kw) -> None:
         """Attach/overwrite span args mid-flight (e.g. an outcome flag
         only known at the end of the spanned work)."""
@@ -133,6 +139,21 @@ class EventTracer:
         wall duration."""
         return _Span(self, name, args)
 
+    def complete(self, name: str, dur: float, **args) -> int:
+        """Record one already-measured span: the caller timed the work
+        and only afterwards learned it deserved an event — the shape of
+        a jit dispatch that turned out to compile (ISSUE 12). Appends a
+        single ``ph="X"`` event whose wall start is reconstructed as
+        now − ``dur`` (export-only, like all wall time here); returns
+        its end sequence number."""
+        seq0 = self._next_seq()
+        seq = self._next_seq()
+        self._append(
+            name=name, ph="X", seq=seq, seq_begin=seq0,
+            ts=time.time() - dur, dur=float(dur), args=args,
+        )
+        return seq
+
     # -- reading / export ----------------------------------------------
 
     def events(self, since_seq: int = 0, name: str | None = None) -> list:
@@ -189,6 +210,10 @@ class _NullSpan:
     def set(self, **kw):
         pass
 
+    @property
+    def begin_seq(self) -> int:
+        return -1
+
 
 class NullTracer:
     """No-op tracer handed out under null mode."""
@@ -200,6 +225,9 @@ class NullTracer:
 
     def span(self, name, **args):
         return self._NULL_SPAN
+
+    def complete(self, name, dur, **args):
+        return -1
 
     @property
     def seq(self) -> int:

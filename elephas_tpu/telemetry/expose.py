@@ -6,6 +6,16 @@ parameter server, ``InferenceEngine.scrape()``, and
 format has exactly one home. The format is Prometheus exposition
 version 0.0.4 (``# HELP`` / ``# TYPE`` comments, ``le``-cumulative
 histogram buckets, ``_sum``/``_count`` series).
+
+ISSUE 12 adds the **OpenMetrics** flavor
+(:func:`render_openmetrics`): identical lines, plus histogram bucket
+samples carry their attached exemplars (`` # {rid="42"} 0.37`` — the
+request id of the observation that landed in that bucket, no
+timestamp: the registry never captures wall time) and the mandatory
+``# EOF`` trailer. The gateway's ``GET /metrics`` serves it when the
+client's ``Accept`` header asks for ``application/openmetrics-text``;
+the 0.0.4 default stays exemplar-free because its parsers treat a
+``#`` after the value as garbage.
 """
 
 from __future__ import annotations
@@ -13,6 +23,9 @@ from __future__ import annotations
 from elephas_tpu.telemetry import registry as _registry_mod
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+CONTENT_TYPE_OPENMETRICS = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 
 def _escape_help(s: str) -> str:
@@ -48,20 +61,42 @@ def _labels_str(names, values, extra=()) -> str:
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
-def render(registry=None) -> str:
+def _exemplar_str(labels_dict, value) -> str:
+    """OpenMetrics exemplar suffix: `` # {rid="42"} 0.37`` (no
+    timestamp — the registry captures none)."""
+    pairs = ",".join(
+        f'{n}="{_escape_label(str(v))}"'
+        for n, v in sorted(labels_dict.items())
+    )
+    return f" # {{{pairs}}} {_fmt(value)}"
+
+
+def render(registry=None, exemplars: bool = False) -> str:
     """The registry's current state as Prometheus exposition text.
 
     Defaults to the REAL process registry (not the null stand-in), so
     a scrape during a null-mode window still shows everything recorded
-    while telemetry was on.
+    while telemetry was on. ``exemplars=True`` (the OpenMetrics
+    flavor; use :func:`render_openmetrics` for the full surface)
+    appends each histogram bucket's attached exemplar to its sample
+    line.
     """
     if registry is None:
         registry = _registry_mod.default_registry()
     lines: list[str] = []
     for fam in registry.collect():
         kind = fam.kind
-        lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
-        lines.append(f"# TYPE {fam.name} {kind}")
+        meta_name = fam.name
+        if exemplars and kind == "counter" \
+                and meta_name.endswith("_total"):
+            # OpenMetrics names a counter FAMILY without the _total
+            # suffix (samples keep it: family + "_total") — this
+            # repo's counters register with _total in the name, so
+            # the OpenMetrics flavor strips it from HELP/TYPE or a
+            # spec-compliant scraper rejects the whole exposition
+            meta_name = meta_name[: -len("_total")]
+        lines.append(f"# HELP {meta_name} {_escape_help(fam.help)}")
+        lines.append(f"# TYPE {meta_name} {kind}")
         for values, child in fam.series():
             labels = _labels_str(fam.labelnames, values)
             if kind in ("counter", "gauge"):
@@ -75,20 +110,36 @@ def render(registry=None) -> str:
                 lines.append(f"{fam.name}{labels} {_fmt(v)}")
                 continue
             counts, total_count, total_sum = child.snapshot()
+            ex = child.exemplars() if exemplars else None
             cumulative = 0
-            for bound, c in zip(child._bounds, counts):
+            for i, (bound, c) in enumerate(zip(child._bounds, counts)):
                 cumulative += c
                 le = _labels_str(
                     fam.labelnames, values, extra=(("le", _fmt(bound)),)
                 )
-                lines.append(f"{fam.name}_bucket{le} {cumulative}")
+                line = f"{fam.name}_bucket{le} {cumulative}"
+                if ex is not None and ex[i] is not None:
+                    line += _exemplar_str(*ex[i])
+                lines.append(line)
             inf = _labels_str(
                 fam.labelnames, values, extra=(("le", "+Inf"),)
             )
-            lines.append(f"{fam.name}_bucket{inf} {total_count}")
+            line = f"{fam.name}_bucket{inf} {total_count}"
+            if ex is not None and ex[-1] is not None:
+                line += _exemplar_str(*ex[-1])
+            lines.append(line)
             lines.append(f"{fam.name}_sum{labels} {_fmt(total_sum)}")
             lines.append(f"{fam.name}_count{labels} {total_count}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_openmetrics(registry=None) -> str:
+    """The OpenMetrics flavor (ISSUE 12): the same sample lines as
+    :func:`render` with histogram exemplars attached, terminated by
+    the mandatory ``# EOF`` marker. This is what a TTFT p99 dashboard
+    scrapes to jump from a latency spike to the rid that caused it
+    (resolve the rid via ``GET /v1/requests/{rid}/trace``)."""
+    return render(registry, exemplars=True) + "# EOF\n"
 
 
 def scrape_text() -> str:

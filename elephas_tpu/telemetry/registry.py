@@ -145,22 +145,47 @@ class GaugeChild:
 class HistogramChild(_Child):
     """Fixed-bucket histogram series. ``observe`` is a bisect over the
     (small, fixed) bound ladder plus two in-place adds on this thread's
-    cell — no allocation, no lock."""
+    cell — no allocation, no lock.
 
-    __slots__ = ("_bounds",)
+    ``observe(v, exemplar={...})`` additionally attaches an
+    **exemplar** — a tiny label set (typically ``{"rid": "42"}``)
+    identifying the observed event — to the bucket the observation
+    landed in, last write wins (one list-slot assignment: atomic under
+    the GIL, no lock, and a ``None`` exemplar costs nothing). The
+    OpenMetrics renderer (:func:`~elephas_tpu.telemetry.expose.\
+render_openmetrics`) emits them after the bucket lines, so a p99 TTFT
+    spike on a dashboard links straight to the request that caused it
+    (ISSUE 12). No wall time is captured — exemplars render without
+    timestamps, keeping this module's determinism contract intact."""
+
+    __slots__ = ("_bounds", "_ex")
 
     def __init__(self, lock: threading.Lock, bounds):
         super().__init__(lock)
         self._bounds = bounds
+        self._ex = None  # per-bucket (labels, value), lazily created
 
     def _new_cell(self):
         # per-bucket counts (+1 overflow bucket for +Inf), sum
         return [[0] * (len(self._bounds) + 1), 0.0]
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         cell = self._cell()
-        cell[0][bisect_left(self._bounds, v)] += 1
+        idx = bisect_left(self._bounds, v)
+        cell[0][idx] += 1
         cell[1] += v
+        if exemplar is not None:
+            ex = self._ex
+            if ex is None:
+                ex = self._ex = [None] * (len(self._bounds) + 1)
+            ex[idx] = (exemplar, v)  # one slot store: GIL-atomic
+
+    def exemplars(self):
+        """Per-bucket ``(labels_dict, observed_value)`` (or ``None``)
+        aligned with :meth:`snapshot`'s bucket order, ``None`` when no
+        exemplar was ever attached."""
+        ex = self._ex
+        return list(ex) if ex is not None else None
 
     def snapshot(self):
         """``(per_bucket_counts, total_count, total_sum)`` — counts are
@@ -241,8 +266,11 @@ class _Family:
     def set_function(self, fn):
         return self._default.set_function(fn)
 
-    def observe(self, v):
-        return self._default.observe(v)
+    def observe(self, v, exemplar=None):
+        return self._default.observe(v, exemplar=exemplar)
+
+    def exemplars(self):
+        return self._default.exemplars()
 
     def snapshot(self):
         return self._default.snapshot()
@@ -313,6 +341,20 @@ class Registry:
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
+                if kind != "counter" and name.endswith("_total"):
+                    # OpenMetrics reserves the _total suffix for
+                    # counters; a gauge/histogram carrying it makes
+                    # the exemplar-bearing exposition (ISSUE 12)
+                    # unparseable to spec-strict scrapers — fail at
+                    # registration, not at scrape time. (Checked only
+                    # on CREATE so a kind-mismatched re-registration
+                    # still gets the clearer error below.)
+                    raise ValueError(
+                        f"{kind} {name!r} uses the counter-reserved "
+                        f"_total suffix — rename it (OpenMetrics "
+                        f"scrapers reject the whole exposition "
+                        f"otherwise)"
+                    )
                 fam = _Family(
                     name, help_, labels, kind, threading.Lock(), **kw
                 )
@@ -407,8 +449,11 @@ class _NullMetric:
     def set_function(self, fn):
         pass
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         pass
+
+    def exemplars(self):
+        return None
 
     def labels(self, **kv):
         return self

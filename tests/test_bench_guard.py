@@ -301,6 +301,13 @@ class TestFaultPathLint:
             f.endswith(os.path.join("serving", "policy.py"))
             for f in files
         )
+        # ISSUE 12: the flight recorder files lifecycle records on the
+        # serving hot path — an eaten error there silently drops the
+        # very evidence trail explain()/the trace route promise
+        assert any(
+            f.endswith(os.path.join("telemetry", "flight.py"))
+            for f in files
+        )
         return root, files
 
     def test_no_bare_or_swallowed_excepts_on_fault_paths(self):
@@ -396,6 +403,19 @@ class TestTelemetryWallClockLint:
             f.endswith(os.path.join("serving", "gateway.py"))
             for f in files
         )
+        # ISSUE 12: the flight recorder and the registry's exemplar
+        # slots store PER-REQUEST evidence — a wall-clock capture
+        # there would smuggle non-deterministic values into records
+        # gang processes are supposed to reconstruct identically
+        # (wall time belongs to the event tracer's export path only);
+        # pinned by name, like the serving modules
+        files.append(os.path.join(
+            root, "elephas_tpu", "telemetry", "flight.py"
+        ))
+        files.append(os.path.join(
+            root, "elephas_tpu", "telemetry", "registry.py"
+        ))
+        assert all(os.path.exists(f) for f in files[-2:])
         offences = []
         for path in files:
             with open(path) as f:
@@ -413,6 +433,63 @@ class TestTelemetryWallClockLint:
             "through elephas_tpu.telemetry (events capture wall time "
             "export-only) or tag the line with "
             "'telemetry-lint: allow <reason>':\n" + "\n".join(offences)
+        )
+
+    _GLOBAL_TELEMETRY = re.compile(
+        r"telemetry\.(tracer|registry|emit|trace_span)\("
+    )
+
+    def test_emission_sites_capture_telemetry_at_construction(self):
+        """ISSUE 12 satellite: every per-request emission site must be
+        null-mode-safe BY CONSTRUCTION — components capture the
+        tracer/registry once, in ``__init__`` (where the captured
+        object is itself the null singleton under null mode), and
+        record through the captured attribute forever after. A
+        module-level ``telemetry.emit(...)`` / ``telemetry.tracer()``
+        creeping into a serving method re-resolves null mode per call:
+        flipping the global flag mid-serve would then fork what an
+        engine records from what it was built to record (the
+        on-vs-null bench comparison silently stops measuring the
+        configured engine). Grep-lint: those calls may appear in
+        ``serving/`` only inside ``__init__`` (tag genuinely intended
+        exceptions with ``telemetry-lint: allow``)."""
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        files = sorted(glob.glob(
+            os.path.join(root, "elephas_tpu", "serving", "*.py")
+        ))
+        assert len(files) > 8
+        offences = []
+        for path in files:
+            with open(path) as f:
+                lines = f.read().splitlines()
+            # indentation-aware __init__ tracking: a nested helper def
+            # inside __init__ (deeper indent) does not end it; the
+            # next def at or above __init__'s own indent does
+            init_indent = None
+            for i, line in enumerate(lines):
+                stripped = line.strip()
+                if stripped.startswith(("def ", "async def ")):
+                    indent = len(line) - len(line.lstrip())
+                    if stripped.startswith("def __init__"):
+                        init_indent = indent
+                    elif init_indent is not None \
+                            and indent <= init_indent:
+                        init_indent = None
+                if not self._GLOBAL_TELEMETRY.search(line):
+                    continue
+                if init_indent is not None:
+                    continue
+                window = lines[max(0, i - 1): min(len(lines), i + 2)]
+                if any("telemetry-lint: allow" in w for w in window):
+                    continue
+                rel = os.path.relpath(path, root)
+                offences.append(f"{rel}:{i + 1}: {stripped}")
+        assert not offences, (
+            "per-request emission through the GLOBAL telemetry "
+            "resolvers outside __init__ — capture registry()/tracer() "
+            "at construction and record through the captured "
+            "attribute (or tag with 'telemetry-lint: allow <reason>'):"
+            "\n" + "\n".join(offences)
         )
 
 

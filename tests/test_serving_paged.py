@@ -514,7 +514,7 @@ def test_paged_stats_match_metrics_scrape(lm):
 
     eng_l = engine.telemetry_label
     assert series(
-        "elephas_serving_blocks_total", "engine", eng_l
+        "elephas_serving_kv_blocks", "engine", eng_l
     ) == s["blocks_total"]
     assert series(
         "elephas_serving_blocks_free", "engine", eng_l
