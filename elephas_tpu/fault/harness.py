@@ -20,6 +20,7 @@ recovery machinery must absorb.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import tempfile
@@ -33,6 +34,17 @@ from elephas_tpu.fault.plan import FaultPlan
 from elephas_tpu.utils import sockets
 
 logger = logging.getLogger(__name__)
+
+# per-run trace ids for the chaos harness (ISSUE 13): the harness is
+# the "edge" of a chaos training run the way the gateway is for a
+# request — one deterministic id per run (process-monotonic counter,
+# no pids/wall time), propagated over the PS wire so worker pushes,
+# server applies, and journal writes merge into one causal story
+_chaos_run_ids = itertools.count()
+
+
+def _chaos_trace_id(kind: str, transport: str, seed: int) -> str:
+    return f"chaos-{kind}-{transport}-s{seed}-r{next(_chaos_run_ids)}"
 
 
 def _require_telemetry(what: str) -> None:
@@ -224,6 +236,82 @@ class PSKiller(threading.Thread):
             span.set(recovered=recovered)
         if recovered:
             self.ps.t_recovered = time.monotonic()
+
+
+class EngineStaller:
+    """Deliberate serving-engine stall injection (ISSUE 13): while
+    active, ``engine.step()`` is replaced by a do-nothing stand-in —
+    queued work stays queued, tokens stop landing — which is exactly
+    the signature the watchdog's ``decode_stall``/``queue_stall``
+    rules must detect (and must CLEAR once the context exits and real
+    steps resume). A fault injector like :class:`PSKiller`: the
+    harness drives control flow by design; telemetry only observes.
+
+    Use as a context manager::
+
+        with EngineStaller(engine):
+            ...probe /healthz, assert the anomaly fired...
+        ...drain, assert it cleared...
+    """
+
+    def __init__(self, engine, sleep_s: float = 0.01):
+        _require_telemetry("EngineStaller")
+        self.engine = engine
+        self.sleep_s = float(sleep_s)
+
+    def __enter__(self) -> "EngineStaller":
+        telemetry.emit(
+            "chaos.engine_stall", engine=self.engine.telemetry_label,
+        )
+
+        def stalled_step():
+            # keep the driver loop cheap while stalled (it spins on
+            # has_work); queued requests stay queued, nothing decodes
+            time.sleep(self.sleep_s)
+            return []
+
+        # instance attribute shadows the bound method; __exit__
+        # deletes it to restore the real step
+        self.engine.step = stalled_step
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        del self.engine.step
+        telemetry.emit(
+            "chaos.engine_resume", engine=self.engine.telemetry_label,
+        )
+
+
+class WatchdogPoller:
+    """Evaluate a watchdog at a fixed cadence on a daemon thread for
+    the duration of a chaos run — the end-to-end wiring the ISSUE-13
+    acceptance asks for (shard kill ⇒ anomaly with the right label ⇒
+    clear on recovery), shared by ``run_sharded_chaos_training`` and
+    the tests so the tested detection is the benchmarked detection."""
+
+    def __init__(self, watchdog, interval_s: float = 0.05):
+        self.watchdog = watchdog
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="elephas-watchdog-poll", daemon=True
+        )
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.watchdog.evaluate()
+            self._stop.wait(self.interval_s)
+
+    def __enter__(self) -> "WatchdogPoller":
+        self._thread.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
 
 
 # -- sharded chaos (ISSUE 6) ---------------------------------------------
@@ -534,6 +622,7 @@ def run_sharded_chaos_training(
     ps_retries: int = 8,
     standby: bool = False,
     trace_export: str | None = None,
+    watch: bool = False,
 ) -> dict:
     """One real async-worker run against a SHARDED restartable PS —
     the multi-shard sibling of :func:`run_chaos_training`, shared by
@@ -549,6 +638,18 @@ def run_sharded_chaos_training(
     and ``other_shards_progress_during_outage`` — updates the
     surviving shards applied inside the recovery window (the
     acceptance criterion's partial-progress proof).
+
+    ISSUE 13: the whole run executes under one minted trace id
+    (``trace_id`` in the result) which the sharded client forwards
+    over the wire — worker sync spans, per-shard applies, and journal
+    writes share it on the exported timeline. ``watch=True``
+    additionally runs a default-rule
+    :class:`~elephas_tpu.telemetry.watch.Watchdog` at 50ms cadence for
+    the run's duration: the shard kill must surface as a
+    ``ps_unreachable`` anomaly labeled with the killed shard, then
+    clear once the parked pushes replay — the fired/cleared event
+    streams and the final report ride back in the result
+    (``watch_anomalies`` / ``watch_cleared`` / ``watch_report``).
     """
     from elephas_tpu.parameter.server import HttpServer, SocketServer
     from elephas_tpu.worker import AsynchronousSparkWorker
@@ -590,27 +691,57 @@ def run_sharded_chaos_training(
 
     worker._client = chaotic_client
 
+    trace_id = _chaos_trace_id("sharded", transport, seed)
+    watchdog = poller = None
+    if watch:
+        from elephas_tpu.telemetry.watch import Watchdog
+
+        watchdog = Watchdog()
+        poller = WatchdogPoller(watchdog)
+
     killer = None
     try:
-        # warmup outside the timed window and before any chaos
-        list(worker.train(iter(zip(x[:batch_size], y[:batch_size]))))
-        baseline = ps.shard_counters(plan.kill_shard)["updates_applied"]
-        if plan.kill_ps_after_updates is not None:
-            killer = ShardKiller(
-                ps,
-                plan.kill_shard,
-                plan.kill_ps_after_updates,
-                restart_delay_s=plan.restart_delay_s,
-                baseline=baseline,
-            )
-            killer.start()
-        t0 = time.perf_counter()
-        list(worker.train(iter(zip(x, y))))
-        dt = time.perf_counter() - t0
+        if poller is not None:
+            poller.__enter__()
+        with telemetry.trace_scope(trace_id):
+            # warmup outside the timed window and before any chaos
+            list(worker.train(iter(zip(x[:batch_size], y[:batch_size]))))
+            baseline = ps.shard_counters(plan.kill_shard)[
+                "updates_applied"
+            ]
+            if plan.kill_ps_after_updates is not None:
+                killer = ShardKiller(
+                    ps,
+                    plan.kill_shard,
+                    plan.kill_ps_after_updates,
+                    restart_delay_s=plan.restart_delay_s,
+                    baseline=baseline,
+                )
+                killer.start()
+            t0 = time.perf_counter()
+            list(worker.train(iter(zip(x, y))))
+            dt = time.perf_counter() - t0
     finally:
         if killer is not None:
+            if ps.kills[plan.kill_shard]:
+                # the kill fired: the killer exits on its own at the
+                # reborn shard's first apply — give it time to OBSERVE
+                # before cancelling. On a fast box the whole post-kill
+                # training can fit inside restart_delay_s, leaving the
+                # final flush's replay as the recovery signal; an
+                # eager cancel here raced that last ~10ms poll and
+                # discarded a recovery that actually happened.
+                killer.join(timeout=15)
             killer.cancel()
             killer.join(timeout=30)
+        if poller is not None:
+            poller.stop()
+    if watchdog is not None:
+        # a few post-run evaluations: PsUnreachableRule clears after
+        # `clear_after` quiet looks, and the run may have ended inside
+        # its hysteresis window
+        for _ in range(4):
+            watchdog.evaluate()
     try:
         per_shard = [ps.shard_counters(i) for i in range(num_shards)]
         final_weights = ps.get_parameters()
@@ -629,6 +760,24 @@ def run_sharded_chaos_training(
             "sharded chaos trace: %d events exported to %s",
             n_events, trace_export,
         )
+    tracer = telemetry.tracer()
+    watch_out = {}
+    if watchdog is not None:
+        watch_out = {
+            "watch_anomalies": [
+                dict(e["args"])
+                for e in tracer.events(
+                    since_seq=trace_seq0, name="watch.anomaly"
+                )
+            ],
+            "watch_cleared": [
+                dict(e["args"])
+                for e in tracer.events(
+                    since_seq=trace_seq0, name="watch.clear"
+                )
+            ],
+            "watch_report": watchdog.report(),
+        }
     killed = plan.kill_shard
     return {
         "transport": transport,
@@ -636,6 +785,8 @@ def run_sharded_chaos_training(
         "rows": rows,
         "epochs": epochs,
         "seed": seed,
+        "trace_id": trace_id,
+        **watch_out,
         "dt_s": dt,
         "samples_per_s": rows * epochs / dt,
         "killed_shard": killed if plan.kill_ps_after_updates else None,
@@ -875,34 +1026,45 @@ def run_chaos_training(
     killer = None
     previous_hook = None
     hook_installed = False
+    # one trace id for the whole run (ISSUE 13): worker sync spans,
+    # wire pushes, server applies, and journal writes merge into one
+    # causal story on the exported timeline
+    trace_id = _chaos_trace_id("single", transport, seed)
     try:
-        # warmup OUTSIDE the timed window and BEFORE any chaos: keras
-        # compile + wire negotiation must not pollute throughput or the
-        # kill trigger
-        list(worker.train(iter(zip(x[:batch_size], y[:batch_size]))))
-        baseline_updates = ps.counters()["updates_applied"]
+        with telemetry.trace_scope(trace_id):
+            # warmup OUTSIDE the timed window and BEFORE any chaos:
+            # keras compile + wire negotiation must not pollute
+            # throughput or the kill trigger
+            list(worker.train(iter(zip(x[:batch_size], y[:batch_size]))))
+            baseline_updates = ps.counters()["updates_applied"]
 
-        if plan is not None and plan.kill_ps_after_updates is not None:
-            killer = PSKiller(
-                ps,
-                plan.kill_ps_after_updates,
-                restart_delay_s=plan.restart_delay_s,
-                baseline=baseline_updates,
-            )
-            killer.start()
-        if plan is not None:
-            hook = plan.make_socket_hook()
-            if hook is not None:
-                previous_hook = sockets.set_fault_hook(hook)
-                hook_installed = True
+            if plan is not None and plan.kill_ps_after_updates is not None:
+                killer = PSKiller(
+                    ps,
+                    plan.kill_ps_after_updates,
+                    restart_delay_s=plan.restart_delay_s,
+                    baseline=baseline_updates,
+                )
+                killer.start()
+            if plan is not None:
+                hook = plan.make_socket_hook()
+                if hook is not None:
+                    previous_hook = sockets.set_fault_hook(hook)
+                    hook_installed = True
 
-        t0 = time.perf_counter()
-        list(worker.train(iter(zip(x, y))))
-        dt = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            list(worker.train(iter(zip(x, y))))
+            dt = time.perf_counter() - t0
     finally:
         if hook_installed:
             sockets.set_fault_hook(previous_hook)
         if killer is not None:
+            if ps.kills:
+                # fired: let the killer observe the reborn server's
+                # first apply before cancelling (see the sharded
+                # harness — eager cancel raced the final flush's
+                # replay on fast boxes)
+                killer.join(timeout=15)
             killer.cancel()
             killer.join(timeout=30)
     try:
@@ -925,6 +1087,7 @@ def run_chaos_training(
         "rows": rows,
         "epochs": epochs,
         "seed": seed,
+        "trace_id": trace_id,
         "dt_s": dt,
         "samples_per_s": rows * epochs / dt,
         # kill→recovery read from the trace stream (ISSUE 5): the

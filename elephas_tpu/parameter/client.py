@@ -304,13 +304,24 @@ class HttpClient(BaseParameterClient):
         with self._tracer.span("ps.pull", client=self.telemetry_label):
             return self._retry(self._get_once)
 
+    @staticmethod
+    def _trace_headers(headers: dict | None = None) -> dict:
+        """Attach the active trace context as ``X-Elephas-Trace``
+        (ISSUE 13). Header-only, so every legacy HTTP server is a
+        clean no-op — it never reads the header."""
+        headers = dict(headers or {})
+        trace = telemetry.current_trace()
+        if trace is not None:
+            headers["X-Elephas-Trace"] = trace
+        return headers
+
     def _get_once(self):
         if self._binary is not False:
             conn = self._connection()
             path = "/parameters.bin" + (
                 "?comp=int8" if self.pull_compression == "int8" else ""
             )
-            conn.request("GET", path)
+            conn.request("GET", path, headers=self._trace_headers())
             resp = conn.getresponse()
             if resp.status == 200:
                 self._binary = True
@@ -338,7 +349,11 @@ class HttpClient(BaseParameterClient):
         skipped server-side) — effectively-once end to end. Against a
         pre-ISSUE-3 binary server the headers are ignored and the old
         double-apply caveat stands."""
-        with self._tracer.span("ps.push", client=self.telemetry_label):
+        # cid/seq on the span args: the merge tool's push↔apply
+        # clock-alignment edge (ISSUE 13), like the socket client's
+        with self._tracer.span(
+            "ps.push", client=self.telemetry_label, cid=self.client_id,
+        ) as span:
             if self._binary is False and self._feedback is None:
                 # known-legacy server + lossless push: pickle the delta
                 # directly, skipping a pointless codec encode+decode pass
@@ -348,6 +363,7 @@ class HttpClient(BaseParameterClient):
                 return
             body = self._encode_update(delta)
             seq = self._next_seq()
+            span.set(seq=seq)
             self._retry(lambda: self._update_once(body, seq))
 
     def _update_once(self, body: bytes, seq: int | None = None) -> None:
@@ -381,14 +397,19 @@ class HttpClient(BaseParameterClient):
         return self._next_seq(), body
 
     def push_encoded(self, seq: int | None, body: bytes) -> None:
-        with self._tracer.span("ps.push", client=self.telemetry_label):
+        with self._tracer.span(
+            "ps.push", client=self.telemetry_label, cid=self.client_id,
+            seq=-1 if seq is None else seq,
+        ):
             self._retry(lambda: self._update_once(body, seq))
 
     def _post_update_bin(self, body: bytes, seq: int | None) -> bool | None:
         """POST /update.bin once. Returns applied?, or None on a 404
         (legacy server — caller falls back)."""
         conn = self._connection()
-        headers = {"Content-Type": "application/octet-stream"}
+        headers = self._trace_headers(
+            {"Content-Type": "application/octet-stream"}
+        )
         if seq is not None:
             headers["X-Elephas-Client"] = self.client_id
             headers["X-Elephas-Seq"] = str(seq)
@@ -485,6 +506,9 @@ class SocketClient(BaseParameterClient):
         # made safe by the server-side dedup
         self._unacked: deque[tuple[int | None, bytes | None]] = deque()
         self._resend: deque[tuple[int, bytes]] = deque()
+        # trace id last forwarded on THIS connection (ISSUE 13): the
+        # b'T' op is sticky server-side, so it resends only on change
+        self._conn_trace: str | None = None
         self._connect()
 
     @property
@@ -498,12 +522,36 @@ class SocketClient(BaseParameterClient):
     def _sequenced(self) -> bool:
         return self._proto_version >= 2
 
+    @property
+    def _traceful(self) -> bool:
+        """Does the peer understand the trace-context op? Gated on the
+        probed protocol version — a version-2 server would treat b'T'
+        as an unknown op and sever the connection, so legacy peers
+        must simply never see it (the clean-no-op contract)."""
+        return self._proto_version >= 3
+
     # -- connection management ----------------------------------------
+
+    def _sync_trace(self) -> None:
+        """Forward this thread's trace context (ISSUE 13) when it
+        changed since the last op on this connection. Fire-and-forget
+        (no ack: it rides the ordered TCP stream ahead of the op it
+        scopes); no-op against pre-protocol-3 servers and outside any
+        scope."""
+        if not self._traceful:
+            return
+        trace = telemetry.current_trace()
+        if trace == self._conn_trace:
+            return
+        raw = (trace or "").encode("utf-8")
+        self._sock.sendall(b"T" + _U16.pack(len(raw)) + raw)
+        self._conn_trace = trace
 
     def _connect(self) -> None:
         self._sock = sockets.connect(
             self.host, self.port, self.connect_timeout, self.io_timeout
         )
+        self._conn_trace = None  # fresh connection: no forwarded trace
         if self._binary is None:
             # capability probe: a binary server answers with its protocol
             # version; a legacy server closes the connection on the
@@ -630,6 +678,7 @@ class SocketClient(BaseParameterClient):
     def _get_once(self):
         self._ensure_sock()
         if self._binary:
+            self._sync_trace()
             self._flush_resends()
             self._drain_acks()
             comp = b"\x01" if self.pull_compression == "int8" else b"\x00"
@@ -650,16 +699,23 @@ class SocketClient(BaseParameterClient):
         version-1 server the old at-least-once caveat stands (a resend
         can double-apply), and a push whose connection dies before its
         pipelined ack is counted in ``updates_lost`` without resend."""
-        with self._tracer.span("ps.push", client=self.telemetry_label):
+        # cid/seq ride the span args so a worker-side ps.push pairs
+        # with the server-side ps.apply across trace exports — the
+        # merge tool's clock-alignment edge (ISSUE 13)
+        with self._tracer.span(
+            "ps.push", client=self.telemetry_label, cid=self.client_id,
+        ) as span:
             if self._binary:
                 body = self._encode_update(delta)  # once: feedback mutates
                 seq = self._next_seq() if self._sequenced else None
+                span.set(seq=-1 if seq is None else seq)
                 self._retry(lambda: self._push_once(seq, body))
             else:
                 self._retry(lambda: self._push_pickle(delta))
 
     def _push_once(self, seq: int | None, body: bytes) -> None:
         self._ensure_sock()
+        self._sync_trace()
         self._flush_resends()
         self._drain_acks()
         if seq is not None:
@@ -698,7 +754,10 @@ class SocketClient(BaseParameterClient):
                 "sharded pushes need the binary protocol; this "
                 "connection negotiated the legacy pickle wire"
             )
-        with self._tracer.span("ps.push", client=self.telemetry_label):
+        with self._tracer.span(
+            "ps.push", client=self.telemetry_label, cid=self.client_id,
+            seq=-1 if seq is None else seq,
+        ):
             self._retry(lambda: self._push_once(seq, body))
 
     # -- liveness (ISSUE 3) -------------------------------------------
@@ -728,6 +787,7 @@ class SocketClient(BaseParameterClient):
 
         def once():
             self._ensure_sock()
+            self._sync_trace()
             self._flush_resends()
             self._drain_acks()
             cid = self.client_id.encode("utf-8")

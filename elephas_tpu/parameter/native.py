@@ -160,6 +160,23 @@ class NativeParameterServer:
         if not self._handle:
             raise OSError(f"native parameter server failed to bind port {port}")
         self.port = self._lib.eps_server_port(self._handle)
+        # telemetry identity (ISSUE 13 satellite): the native core has
+        # no Python-visible update counters, but the store is still a
+        # fleet member — it joins the same `server=` label family as
+        # the Python servers with a pull-time store-size gauge, and
+        # scrape() makes it readable by the aggregator like any other
+        # transport
+        from elephas_tpu import telemetry
+
+        reg = telemetry.registry()
+        self._telemetry_registry = reg
+        self.telemetry_label = telemetry.instance_label()
+        total_bytes = float(self._flat.total * 4)  # f32 store
+        reg.gauge(
+            "elephas_ps_store_bytes",
+            "Bytes held by the parameter-server weight store",
+            labels=("server",),
+        ).labels(server=self.telemetry_label).set(total_bytes)
         self.journal_dir = journal_dir
         self.restored_from_journal = False
         if journal_dir and restore_journal:
@@ -183,6 +200,27 @@ class NativeParameterServer:
 
     def start(self) -> None:  # the C++ accept loop starts at create
         pass
+
+    def scrape(self, full: bool = False) -> str:
+        """This server's ``server=``-labeled series as Prometheus
+        exposition text (``full=True`` = the whole process registry) —
+        scrape parity with the Python servers (ISSUE 13 satellite), so
+        a FleetScraper can target any transport."""
+        from elephas_tpu import telemetry
+
+        if full:
+            return telemetry.render(self._telemetry_registry)
+        return telemetry.render(
+            self._telemetry_registry,
+            only={"server": self.telemetry_label},
+        )
+
+    def release_telemetry(self) -> None:
+        """Retire this server's labeled series (explicit-only, same
+        contract as the Python servers')."""
+        from elephas_tpu import telemetry
+
+        telemetry.remove_series(server=self.telemetry_label)
 
     def set_weights(self, weights) -> None:
         flat = np.ascontiguousarray(self._flat.flatten(weights))

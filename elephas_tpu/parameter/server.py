@@ -38,8 +38,10 @@ byte), ``b'G'`` binary get (+1 request byte: 0 dense / 1 int8),
 sequenced binary update (u16 id-length + client id + u64 seq + frames
 in; ``b'k'`` applied / ``b'd'`` duplicate-skipped out), ``b'H'``
 heartbeat (u16 id-length + client id; ``b'k'`` out), ``b's'`` status
-(u32 length + JSON out), and the legacy ``b'g'`` / ``b'u'`` / ``b'q'``
-pickle trio.
+(u32 length + JSON out), ``b'T'`` trace context (protocol 3, ISSUE
+13: u16 length + trace-id bytes, no reply — sets the connection's
+current trace id; empty clears it), and the legacy ``b'g'`` /
+``b'u'`` / ``b'q'`` pickle trio.
 
 HTTP: ``GET /parameters.bin[?comp=int8]`` streams codec frames with
 chunked transfer-encoding; ``POST /update.bin`` carries codec frames in
@@ -49,6 +51,17 @@ a duplicate); ``POST /heartbeat`` refreshes the client's lease;
 ``GET /status`` returns the status JSON; legacy ``/parameters`` /
 ``/update`` stay pickled. Responses are HTTP/1.1 so clients reuse one
 connection across sync rounds.
+
+ISSUE 13 (cross-process tracing): clients forward their active trace
+context — the ``b'T'`` socket op, or an ``X-Elephas-Trace`` header on
+the HTTP ops — and the server evaluates every op under that scope, so
+the ``ps.apply`` span, the dedup decision, and any journal write the
+apply triggers all land on this process's trace stream stamped with
+the SAME trace id the worker-side push span carries. Guarded both
+ways: a protocol-2 server never receives the op (clients gate on the
+probed version), a legacy client never sends it, and an HTTP server
+that predates the header simply ignores it — clean no-ops on every
+legacy pairing.
 """
 
 from __future__ import annotations
@@ -75,7 +88,8 @@ from elephas_tpu.utils.functional_utils import add_params
 logger = logging.getLogger(__name__)
 
 # version 2: sequenced updates (S), heartbeats (H), status (s)
-PROTOCOL_VERSION = 2
+# version 3: trace-context forwarding (T / X-Elephas-Trace, ISSUE 13)
+PROTOCOL_VERSION = 3
 
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
@@ -170,6 +184,7 @@ class BaseParameterServer:
         # -- telemetry (ISSUE 5): counters are the single store for the
         # reported values; `updates_applied` etc. read them back
         reg = telemetry.registry()
+        self._telemetry_registry = reg
         sid = telemetry.instance_label()
         self.telemetry_label = sid
         self._tracer = telemetry.tracer()
@@ -309,6 +324,26 @@ class BaseParameterServer:
         series after retirement."""
         telemetry.remove_series(server=self.telemetry_label)
 
+    def scrape(self, full: bool = False) -> str:
+        """This server's series as Prometheus exposition text — the
+        in-process scrape surface every transport now shares (ISSUE 13
+        satellite: before this, only the HTTP server exposed
+        ``/metrics``, so a Socket/Native deployment was invisible to
+        the fleet aggregator). Default: ONLY this instance's
+        ``server=``-labeled series — the right unit for
+        :class:`~elephas_tpu.telemetry.aggregate.FleetScraper`, whose
+        ``instance=`` relabeling is meaningless if every in-process
+        target returns the whole shared registry. ``full=True``
+        returns the entire process registry (the HTTP ``/metrics``
+        behavior). Empty when the server was constructed under
+        telemetry null mode."""
+        if full:
+            return telemetry.render(self._telemetry_registry)
+        return telemetry.render(
+            self._telemetry_registry,
+            only={"server": self.telemetry_label},
+        )
+
     # -- weight store --------------------------------------------------
 
     def get_parameters(self) -> list[np.ndarray]:
@@ -330,20 +365,35 @@ class BaseParameterServer:
         """Apply one delta, idempotently when ``(client_id, seq)`` is
         given: a sequence ID at or below the client's last applied one
         is skipped (the at-least-once wire resend case). Returns True
-        iff the delta was applied."""
-        if client_id is None or seq is None:
-            self.update_parameters(delta)
+        iff the delta was applied.
+
+        The whole apply — dedup decision included, and any journal
+        write ``_note_update`` triggers — runs inside one ``ps.apply``
+        span carrying ``(client_id, seq)``, so it pairs with the
+        worker-side ``ps.push`` span across process trace exports
+        (the merge tool's alignment edge, ISSUE 13); a forwarded
+        trace context stamps it via the ambient scope."""
+        with self._tracer.span(
+            "ps.apply", server=self.telemetry_label,
+            client_id="" if client_id is None else str(client_id),
+            seq=-1 if seq is None else int(seq),
+        ) as span:
+            if client_id is None or seq is None:
+                self.update_parameters(delta)
+                self._note_update()
+                span.set(applied=True)
+                return True
+            with self._seq_lock:
+                if seq <= self.seq_table.get(client_id, -1):
+                    self._m_updates_duplicate.inc()
+                    span.set(applied=False)
+                    return False
+                self.update_parameters(delta)
+                self.seq_table[client_id] = int(seq)
+            self.heartbeat(client_id)
             self._note_update()
+            span.set(applied=True)
             return True
-        with self._seq_lock:
-            if seq <= self.seq_table.get(client_id, -1):
-                self._m_updates_duplicate.inc()
-                return False
-            self.update_parameters(delta)
-            self.seq_table[client_id] = int(seq)
-        self.heartbeat(client_id)
-        self._note_update()
-        return True
 
     def set_weights(self, weights) -> None:
         with self.lock:
@@ -524,7 +574,25 @@ class HttpServer(BaseParameterServer):
             def log_message(self, *args):  # silence request logging
                 pass
 
+            def _trace_scope(self):
+                """Evaluate this request under the client's forwarded
+                trace context (ISSUE 13) — absent header = no scope,
+                so legacy clients cost nothing."""
+                from elephas_tpu.telemetry import trace_scope
+
+                return trace_scope(
+                    self.headers.get("X-Elephas-Trace") or None
+                )
+
             def do_GET(self):
+                with self._trace_scope():
+                    self._do_get()
+
+            def do_POST(self):
+                with self._trace_scope():
+                    self._do_post()
+
+            def _do_get(self):
                 path, _, query = self.path.partition("?")
                 if path == "/parameters.bin":
                     comp = "int8" if "comp=int8" in query else "none"
@@ -592,7 +660,7 @@ class HttpServer(BaseParameterServer):
                     got += len(chunk)
                 return b"".join(chunks)
 
-            def do_POST(self):
+            def _do_post(self):
                 if self.path == "/heartbeat":
                     cid = self.headers.get("X-Elephas-Client")
                     length = int(self.headers.get("Content-Length", 0))
@@ -690,51 +758,79 @@ class SocketServer(BaseParameterServer):
                     ps._untrack(sock)
 
             def _serve(self, sock):
+                from elephas_tpu.telemetry import trace_scope
+
                 sock.setsockopt(
                     socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
                 )
+                # connection-sticky trace context (ISSUE 13): the
+                # b'T' op sets it, every later op on this connection
+                # evaluates under it until changed/cleared — mirrors
+                # the per-request HTTP header with one op per trace
+                # CHANGE instead of per push
+                conn_trace = None
                 while True:
                     op = sock.recv(1)
                     if not op or op == b"q" or ps._closing:
                         return
-                    if op == b"?":
-                        sock.sendall(bytes([PROTOCOL_VERSION]))
-                    elif op == b"G":
-                        comp = sockets.read_exact(sock, 1)
-                        frames = ps.encode_parameters(
-                            "int8" if comp == b"\x01" else "none"
+                    if op == b"T":
+                        (tlen,) = _U16.unpack(
+                            sockets.read_exact(sock, 2)
                         )
-                        sockets.send_frames(sock, frames)
-                    elif op == b"U":
-                        delta = wire.decode_stream(
-                            sockets.reader(sock), sockets.reader_into(sock)
+                        raw = (
+                            sockets.read_exact(sock, tlen) if tlen
+                            else b""
                         )
-                        ps.apply_update(delta)
-                        sock.sendall(b"k")
-                    elif op == b"S":
-                        # sequenced update: id + seq header, then frames;
-                        # the frames are always consumed (self-delimiting
-                        # stream), the dedup decision follows
-                        cid = _read_client_id(sock)
-                        (seq,) = _U64.unpack(sockets.read_exact(sock, 8))
-                        delta = wire.decode_stream(
-                            sockets.reader(sock), sockets.reader_into(sock)
+                        conn_trace = (
+                            raw.decode("utf-8", "replace") or None
                         )
-                        applied = ps.apply_update(delta, cid, seq)
-                        sock.sendall(b"k" if applied else b"d")
-                    elif op == b"H":
-                        ps.heartbeat(_read_client_id(sock))
-                        sock.sendall(b"k")
-                    elif op == b"s":
-                        payload = json.dumps(ps.status()).encode()
-                        sock.sendall(_U32.pack(len(payload)) + payload)
-                    elif op == b"g":  # legacy-pickle fallback
-                        sockets.send(sock, ps.get_parameters())
-                    elif op == b"u":  # legacy-pickle fallback
-                        delta = sockets.receive(sock)
-                        ps.apply_update(delta)
-                    else:
-                        return
+                        continue
+                    with trace_scope(conn_trace):
+                        if not self._one_op(sock, op):
+                            return
+
+            def _one_op(self, sock, op) -> bool:
+                """Serve one op; False = unknown op, sever the
+                connection (the pre-ISSUE-13 loop's `else: return`)."""
+                if op == b"?":
+                    sock.sendall(bytes([PROTOCOL_VERSION]))
+                elif op == b"G":
+                    comp = sockets.read_exact(sock, 1)
+                    frames = ps.encode_parameters(
+                        "int8" if comp == b"\x01" else "none"
+                    )
+                    sockets.send_frames(sock, frames)
+                elif op == b"U":
+                    delta = wire.decode_stream(
+                        sockets.reader(sock), sockets.reader_into(sock)
+                    )
+                    ps.apply_update(delta)
+                    sock.sendall(b"k")
+                elif op == b"S":
+                    # sequenced update: id + seq header, then frames;
+                    # the frames are always consumed (self-delimiting
+                    # stream), the dedup decision follows
+                    cid = _read_client_id(sock)
+                    (seq,) = _U64.unpack(sockets.read_exact(sock, 8))
+                    delta = wire.decode_stream(
+                        sockets.reader(sock), sockets.reader_into(sock)
+                    )
+                    applied = ps.apply_update(delta, cid, seq)
+                    sock.sendall(b"k" if applied else b"d")
+                elif op == b"H":
+                    ps.heartbeat(_read_client_id(sock))
+                    sock.sendall(b"k")
+                elif op == b"s":
+                    payload = json.dumps(ps.status()).encode()
+                    sock.sendall(_U32.pack(len(payload)) + payload)
+                elif op == b"g":  # legacy-pickle fallback
+                    sockets.send(sock, ps.get_parameters())
+                elif op == b"u":  # legacy-pickle fallback
+                    delta = sockets.receive(sock)
+                    ps.apply_update(delta)
+                else:
+                    return False
+                return True
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

@@ -267,6 +267,16 @@ class ShardedServerGroup:
     def status(self) -> list[dict]:
         return [s.status() for s in self.servers]
 
+    def scrape_all(self) -> dict[int, str]:
+        """Per-shard Prometheus exposition text keyed by shard id
+        (ISSUE 13 satellite): each shard's OWN ``server=``-labeled
+        series via :meth:`~elephas_tpu.parameter.server.\
+BaseParameterServer.scrape` — the ready-made target map for a
+        :class:`~elephas_tpu.telemetry.aggregate.FleetScraper`
+        (``{f"shard-{i}": group.servers[i].scrape for i in ...}``)
+        and the quick operator answer to "which shard is behind"."""
+        return {i: s.scrape() for i, s in enumerate(self.servers)}
+
     @property
     def updates_applied(self) -> int:
         return sum(s.updates_applied for s in self.servers)

@@ -1233,6 +1233,17 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         documented behavior as refreshing mid-decode.)"""
         import jax.numpy as jnp
 
+        # lifecycle event (ISSUE 13): a weight push travelling
+        # worker → PS → engine ends HERE — emitting under the caller's
+        # trace scope stamps the same trace id the push carried, so
+        # the deployment is one causal story on the merged timeline.
+        # getattr-guarded: the constructor calls refresh_weights()
+        # before the telemetry capture exists.
+        tracer = getattr(self, "_tracer", None)
+        if tracer is not None:
+            tracer.emit(
+                "serve.refresh_weights", engine=self.telemetry_label,
+            )
         # guarded for the constructor's first call (scheduler not
         # built yet — nothing cached before weights exist)
         scheduler = getattr(self, "scheduler", None)

@@ -129,7 +129,8 @@ class Gateway:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  read_timeout: float = READ_TIMEOUT,
                  max_body: int = MAX_BODY,
-                 health_stall_grace: float = 120.0):
+                 health_stall_grace: float = 120.0,
+                 watchdog=None):
         self.engine = engine
         self.host = host
         self._want_port = int(port)
@@ -185,6 +186,27 @@ class Gateway:
             "SSE token streams currently open",
             labels=("gateway",),
         ).labels(gateway=gid)
+        # anomaly watchdog (ISSUE 13): rules evaluate at /healthz
+        # PROBE cadence — never per step/token, the hot-path contract
+        # — and the report embeds as healthz detail so a fleet router
+        # reads liveness AND the why in one probe. None under null
+        # mode (inert by construction); pass watchdog=False to opt
+        # out, or a prebuilt Watchdog (e.g. with tuned rules).
+        from elephas_tpu.telemetry.watch import Watchdog
+
+        if watchdog is None:
+            watchdog = (
+                Watchdog() if not telemetry.null_mode() else None
+            )
+        elif watchdog is False or watchdog == 0:
+            watchdog = None
+        elif not isinstance(watchdog, Watchdog):
+            raise TypeError(
+                f"watchdog must be a telemetry.watch.Watchdog, None "
+                f"(auto), or False (off), got "
+                f"{type(watchdog).__name__}"
+            )
+        self.watchdog = watchdog
 
     # -- lifecycle ------------------------------------------------------
 
@@ -345,9 +367,17 @@ class Gateway:
                     self._read_request(reader), self.read_timeout
                 )
                 route = self._route_label(method, path)
-                with self._tracer.span("gateway.request", route=route):
+                # gateway label + (for /v1/generate, set below) the
+                # engine-minted rid ride the span args: the trace-merge
+                # tool (ISSUE 13) keys the request's trace id off the
+                # rid, so the gateway half of the story joins the
+                # engine half under ONE id on the merged timeline
+                with self._tracer.span(
+                    "gateway.request", route=route,
+                    gateway=self.telemetry_label,
+                ) as span:
                     code = await self._route(
-                        method, path, body, headers, writer
+                        method, path, body, headers, writer, span
                     )
             except _HttpError as e:
                 code = e.code
@@ -445,12 +475,13 @@ class Gateway:
         writer.write(data)
         await writer.drain()
 
-    async def _route(self, method, path, body, headers, writer) -> int:
+    async def _route(self, method, path, body, headers, writer,
+                     span=None) -> int:
         path = path.split("?", 1)[0]
         if path == "/v1/generate":
             if method != "POST":
                 raise _HttpError(405, "POST only")
-            return await self._generate(body, writer)
+            return await self._generate(body, writer, span)
         if path == "/metrics":
             if method != "GET":
                 raise _HttpError(405, "GET only")
@@ -562,6 +593,28 @@ class Gateway:
             "queue_has_work": has_work,
             "driver_alive": alive,
         }
+        if self.watchdog is not None:
+            # anomaly detail (ISSUE 13): evaluated HERE, at probe
+            # cadence. Report-only — anomalies never flip the 200/503
+            # verdict (that would let telemetry drive routing; the
+            # stall/driver checks above are the liveness authority) —
+            # but the router gets the why alongside the what.
+            # Off-loop like every other registry walk: evaluation
+            # reads pull-time callback gauges whose cost grows with
+            # tenants/series, and a probe must never stall in-flight
+            # SSE streams (the handler's own never-block design).
+            loop = asyncio.get_running_loop()
+
+            def evaluate():
+                self.watchdog.evaluate()
+                return self.watchdog.report()
+
+            report = await loop.run_in_executor(None, evaluate)
+            body["anomalies"] = {
+                "critical": report["critical"],
+                "warn": report["warn"],
+                "active": report["active"],
+            }
         await self._write(writer, _json_response(
             200 if status == "ok" else 503, body
         ))
@@ -586,7 +639,7 @@ class Gateway:
             )
         return spec
 
-    async def _generate(self, body, writer) -> int:
+    async def _generate(self, body, writer, span=None) -> int:
         spec = self._parse_generate(body)
         stream = bool(spec.pop("stream", True))
         loop = asyncio.get_running_loop()
@@ -618,6 +671,10 @@ class Gateway:
             req = await loop.run_in_executor(None, do_submit)
         except (ValueError, TypeError) as e:
             raise _HttpError(400, str(e))
+        if span is not None:
+            # the request's trace identity on the gateway span — rid
+            # is minted by the engine, so it only exists post-submit
+            span.set(rid=req.rid)
         if req.error is not None:
             # rejected at submit — backpressure on the wire. The rid
             # still echoes (ISSUE 12): the rejection has a flight

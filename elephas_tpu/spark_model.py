@@ -20,6 +20,7 @@ pickle round-trips.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
@@ -35,6 +36,11 @@ from elephas_tpu.utils import rdd_utils
 from elephas_tpu.worker import MeshRunner, MODES, FREQUENCIES
 
 logger = logging.getLogger(__name__)
+
+# trace-id run counter for fit() (ISSUE 13): process-monotonic like
+# telemetry.instance_label(), so gang processes running identical
+# schedules mint identical ids (no pids, no wall time)
+_fit_trace_ids = itertools.count()
 
 
 class _WeightPublisher:
@@ -816,7 +822,17 @@ class SparkModel:
                 import contextlib
 
                 trace_ctx = contextlib.nullcontext()
-            with trace_ctx:
+            # cross-process trace context minted at the training edge
+            # (ISSUE 13): every event this fit records — fit.epoch
+            # boundaries, weight publications, and any PS round-trips
+            # on this thread — carries one deterministic run id, and
+            # the PS clients forward it over the wire so server-side
+            # applies/journal writes join the same trace. The id is a
+            # process-monotonic run count + start epoch: no pids, no
+            # wall time (gang processes mint identical ids).
+            with trace_ctx, telemetry.trace_scope(
+                f"fit-r{next(_fit_trace_ids)}e{start_epoch}"
+            ):
                 if stream is not None:
                     history = runner.run_epochs_stream(
                         stream, epochs, verbose, callbacks=callbacks

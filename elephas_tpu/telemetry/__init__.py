@@ -35,8 +35,11 @@ Two contracts everything else in the codebase leans on:
 from elephas_tpu.telemetry.events import (  # noqa: F401
     EventTracer,
     NullTracer,
+    current_trace,
     default_tracer,
     emit,
+    set_trace,
+    trace_scope,
     trace_span,
     tracer,
 )
@@ -47,7 +50,16 @@ from elephas_tpu.telemetry.expose import (  # noqa: F401
     render_openmetrics,
     scrape_text,
 )
+from elephas_tpu.telemetry.aggregate import (  # noqa: F401
+    FleetScraper,
+    parse_exposition,
+)
 from elephas_tpu.telemetry.flight import FlightRecorder  # noqa: F401
+from elephas_tpu.telemetry.watch import (  # noqa: F401
+    Anomaly,
+    Watchdog,
+    default_rules,
+)
 from elephas_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_TIME_BUCKETS,
     NULL_METRIC,
@@ -67,6 +79,11 @@ __all__ = [
     "EventTracer",
     "NullTracer",
     "FlightRecorder",
+    "FleetScraper",
+    "parse_exposition",
+    "Watchdog",
+    "Anomaly",
+    "default_rules",
     "DEFAULT_TIME_BUCKETS",
     "NULL_METRIC",
     "CONTENT_TYPE",
@@ -81,6 +98,9 @@ __all__ = [
     "tracer",
     "default_tracer",
     "trace_span",
+    "trace_scope",
+    "current_trace",
+    "set_trace",
     "emit",
     "render",
     "scrape_text",

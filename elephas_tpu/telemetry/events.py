@@ -27,10 +27,27 @@ timeline.
 
 Null mode (:func:`~elephas_tpu.telemetry.registry.set_null`) swaps
 :func:`tracer` for a no-op tracer, same as the metrics registry.
+
+**Cross-process trace context (ISSUE 13).** A *trace id* is a plain
+string minted once at the edge of a causal story — the gateway derives
+one from the request id, ``SparkModel.fit`` mints one per run, the
+chaos harness per training run — and carried along so every event the
+story touches (worker sync spans, PS pushes, server-side applies,
+journal writes) lands stamped with the same id, even across the PS
+wire (the clients forward the current id as a guarded protocol-3
+extension; see ``parameter/server.py``). The context is **thread-
+local** (:func:`trace_scope` / :func:`set_trace` /
+:func:`current_trace`): any event appended while a scope is active
+gains a ``trace=<id>`` arg automatically, unless the call site already
+stamped its own. Like everything here, the context is report-only —
+nothing reads it to make a decision — and ids must contain no wall
+time or pids (the label-determinism contract), so gang processes
+driving identical schedules mint identical ids.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -40,6 +57,45 @@ from collections import deque
 from elephas_tpu.telemetry import registry as _registry_mod
 
 DEFAULT_CAPACITY = 8192
+
+# -- cross-process trace context (ISSUE 13) ------------------------------
+
+_trace_tls = threading.local()
+
+
+def current_trace() -> str | None:
+    """The thread's active trace id (None outside any scope)."""
+    return getattr(_trace_tls, "trace", None)
+
+
+def set_trace(trace_id: str | None) -> str | None:
+    """Set (or clear, with None) this thread's trace context; returns
+    the previous value so callers can restore it. Prefer
+    :func:`trace_scope` — explicit set/restore is for wire handlers
+    whose scope boundary is a protocol op, not a ``with`` block."""
+    previous = current_trace()
+    _trace_tls.trace = trace_id if trace_id else None
+    return previous
+
+
+@contextlib.contextmanager
+def trace_scope(trace_id: str | None):
+    """``with trace_scope("fit-0"): ...`` — every event appended by
+    THIS thread inside the block carries ``trace="fit-0"``, and the
+    PS clients forward the id over the wire so the server-side apply/
+    journal events join the same trace. Scopes nest (the inner id
+    wins, the outer is restored on exit); ``trace_scope(None)`` is a
+    no-op passthrough — the ambient scope (if any) stays active — so
+    call sites need no conditional (use :func:`set_trace` to clear
+    explicitly)."""
+    if trace_id is None:
+        yield None
+        return
+    previous = set_trace(trace_id)
+    try:
+        yield trace_id
+    finally:
+        set_trace(previous)
 
 
 class _Span:
@@ -112,6 +168,13 @@ class EventTracer:
 
     def _append(self, *, name, ph, seq, ts, args, dur=None,
                 seq_begin=None):
+        # cross-process trace context (ISSUE 13): an active scope
+        # stamps every event appended by this thread — call sites that
+        # stamped their own `trace` arg win (a wire handler may carry
+        # a peer's id while a local scope is also live)
+        trace = current_trace()
+        if trace is not None and "trace" not in args:
+            args["trace"] = trace
         event = {
             "name": name,
             "ph": ph,

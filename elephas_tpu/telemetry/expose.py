@@ -71,7 +71,8 @@ def _exemplar_str(labels_dict, value) -> str:
     return f" # {{{pairs}}} {_fmt(value)}"
 
 
-def render(registry=None, exemplars: bool = False) -> str:
+def render(registry=None, exemplars: bool = False,
+           only: dict | None = None) -> str:
     """The registry's current state as Prometheus exposition text.
 
     Defaults to the REAL process registry (not the null stand-in), so
@@ -80,12 +81,35 @@ def render(registry=None, exemplars: bool = False) -> str:
     flavor; use :func:`render_openmetrics` for the full surface)
     appends each histogram bucket's attached exemplar to its sample
     line.
+
+    ``only`` (ISSUE 13) filters to series matching every given
+    ``label=value`` pair; families that lack one of the label NAMES
+    are skipped entirely. This is how a single component scrapes
+    *itself* out of the shared process registry (e.g.
+    ``BaseParameterServer.scrape()`` passes its own ``server=``
+    label) — the unit the fleet aggregator relabels per instance.
     """
     if registry is None:
         registry = _registry_mod.default_registry()
+    only_items = (
+        None if only is None
+        else [(str(k), str(v)) for k, v in sorted(only.items())]
+    )
     lines: list[str] = []
     for fam in registry.collect():
         kind = fam.kind
+        if only_items is not None:
+            if any(k not in fam.labelnames for k, _v in only_items):
+                continue
+            idx = [(fam.labelnames.index(k), v) for k, v in only_items]
+            series = [
+                (values, child) for values, child in fam.series()
+                if all(values[i] == v for i, v in idx)
+            ]
+            if not series:
+                continue
+        else:
+            series = None
         meta_name = fam.name
         if exemplars and kind == "counter" \
                 and meta_name.endswith("_total"):
@@ -97,7 +121,9 @@ def render(registry=None, exemplars: bool = False) -> str:
             meta_name = meta_name[: -len("_total")]
         lines.append(f"# HELP {meta_name} {_escape_help(fam.help)}")
         lines.append(f"# TYPE {meta_name} {kind}")
-        for values, child in fam.series():
+        for values, child in (
+            fam.series() if series is None else series
+        ):
             labels = _labels_str(fam.labelnames, values)
             if kind in ("counter", "gauge"):
                 try:

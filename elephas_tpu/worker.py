@@ -938,23 +938,29 @@ class OverlappedSync:
         self._queue: queue.Queue = queue.Queue()
         self._pending: collections.deque[Future] = collections.deque()
         self.max_in_flight = 0  # high-water mark (tested staleness bound)
+        # trace context is THREAD-local (ISSUE 13) and the wire ops
+        # below run on this daemon thread — capture the constructing
+        # thread's scope so overlapped rounds stamp (and forward) the
+        # same trace id the blocking path would
+        self._trace_id = telemetry.current_trace()
         self._thread = threading.Thread(
             target=self._run, name="elephas-ps-sync", daemon=True
         )
         self._thread.start()
 
     def _run(self):
-        while True:
-            item = self._queue.get()
-            if item is None:
-                return
-            delta, fut = item
-            try:
-                if delta is not None:
-                    self.client.update_parameters(delta)
-                fut.set_result(self.client.get_parameters())
-            except BaseException as e:  # surfaced at submit/drain
-                fut.set_exception(e)
+        with telemetry.trace_scope(self._trace_id):
+            while True:
+                item = self._queue.get()
+                if item is None:
+                    return
+                delta, fut = item
+                try:
+                    if delta is not None:
+                        self.client.update_parameters(delta)
+                    fut.set_result(self.client.get_parameters())
+                except BaseException as e:  # surfaced at submit/drain
+                    fut.set_exception(e)
 
     def submit(self, delta) -> Future:
         """Queue one round (push ``delta``, then pull fresh weights)."""
@@ -1127,6 +1133,7 @@ class AsynchronousSparkWorker(SparkWorker):
         ps_retries: int = 6,
         ps_retry_max_delay: float = 5.0,
         client_id: str | None = None,
+        trace_id: str | None = None,
     ):
         super().__init__(
             json_model,
@@ -1151,6 +1158,13 @@ class AsynchronousSparkWorker(SparkWorker):
         self.ps_retries = max(0, int(ps_retries))
         self.ps_retry_max_delay = float(ps_retry_max_delay)
         self.client_id = client_id
+        # cross-process trace context (ISSUE 13): when set, train()
+        # runs under this trace id — its sync spans, retries, and PS
+        # round-trips all stamp it, and the clients forward it over
+        # the wire so server-side applies join the same trace. When
+        # None, train() inherits the caller's ambient scope (the chaos
+        # harness / SparkModel.fit shape).
+        self.trace_id = trace_id
         # telemetry (ISSUE 5): the supervised retry loop and sync
         # cadence become observable — a rising retry rate is the
         # earliest signal of a struggling PS, visible on the same
@@ -1303,6 +1317,12 @@ class AsynchronousSparkWorker(SparkWorker):
         x, y = self._stack(data_iterator)
         if x is None:
             return
+        # trace_scope(None) is a passthrough: without an explicit
+        # trace_id this worker inherits whatever scope the caller set
+        with telemetry.trace_scope(self.trace_id):
+            yield from self._train_scoped(x, y, subtract_params)
+
+    def _train_scoped(self, x, y, subtract_params):
         model = self._build()
         client = self._client(model)
         epochs = self.train_config.get("epochs", 1)

@@ -48,6 +48,7 @@ _PS_DEADLINE_MODULES = (
     "test_fault_tolerance",
     "test_ps_sharding",
     "test_telemetry",
+    "test_telemetry_fleet",
 )
 PS_TEST_DEADLINE_S = 120
 

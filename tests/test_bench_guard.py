@@ -337,6 +337,115 @@ class TestFaultPathLint:
         )
 
 
+class TestMetricDocDrift:
+    """ISSUE 13 satellite: every ``elephas_*`` metric family name
+    registered anywhere in ``elephas_tpu/`` must appear in the
+    docs/API.md metric catalog — scrape-surface drift (a renamed gauge
+    whose docs row still shows the old name, a new counter nobody
+    documented) is fixed at the SOURCE by failing this lint. The docs
+    may use brace shorthand (``elephas_serving_slo_{met,missed}_total``
+    expands to both names); a deliberately-undocumented name carries a
+    ``metric-doc: allow`` tag with its reason on/near the literal.
+    This lint caught two real drifts on landing: the undocumented
+    ``elephas_ps_client_shard_pauses_total`` and a catalog row still
+    naming ``elephas_serving_blocks_total`` (renamed
+    ``elephas_serving_kv_blocks`` in PR 12)."""
+
+    # a metric name: elephas_<subsystem>_<rest> — the second
+    # underscore-separated segment requirement excludes the package
+    # name "elephas_tpu" appearing as a plain string
+    _METRIC_LITERAL = re.compile(r'"(elephas_[a-z0-9]+_[a-z0-9_]+)"')
+    # docs tokens, brace shorthand included
+    _DOC_TOKEN = re.compile(r"elephas_[a-z0-9_{},]*[a-z0-9_}]")
+
+    @staticmethod
+    def _expand_braces(token: str) -> set:
+        """Every name a docs token can denote. A brace group is
+        either NAME shorthand (``a_{b,c}_total`` -> a_b_total,
+        a_c_total) or a LABEL selector (``a_total{worker}``), and a
+        token may carry both — so each group yields its alternative
+        substitutions AND the truncation at the brace. Bogus
+        concatenations from substituting a label selector never
+        collide with a real registered name."""
+        out: set = set()
+
+        def rec(t: str) -> None:
+            m = re.search(r"\{([^{}]*)\}", t)
+            if m is None:
+                out.add(t)
+                return
+            out.add(t[: m.start()])  # label-selector reading
+            for alt in m.group(1).split(","):
+                rec(t[: m.start()] + alt + t[m.end():])
+
+        rec(token)
+        return out
+
+    def _documented_names(self, root) -> set:
+        with open(os.path.join(root, "docs", "API.md")) as f:
+            text = f.read()
+        names = set()
+        for token in self._DOC_TOKEN.findall(text):
+            names.update(self._expand_braces(token))
+            # a label selector with `=` inside (`{engine=,kernel=}`)
+            # truncates the token match itself — the bare name before
+            # the brace is still the documented name
+            names.add(token.split("{", 1)[0])
+        return names
+
+    def _registered_names(self, root):
+        """``(name, file:line)`` for every metric-name string literal
+        in the package, minus ``metric-doc: allow``-tagged lines."""
+        out = []
+        for path in sorted(glob.glob(
+            os.path.join(root, "elephas_tpu", "**", "*.py"),
+            recursive=True,
+        )):
+            with open(path) as f:
+                lines = f.read().splitlines()
+            for i, line in enumerate(lines):
+                for m in self._METRIC_LITERAL.finditer(line):
+                    window = lines[max(0, i - 1): min(len(lines), i + 2)]
+                    if any("metric-doc: allow" in w for w in window):
+                        continue
+                    rel = os.path.relpath(path, root)
+                    out.append((m.group(1), f"{rel}:{i + 1}"))
+        return out
+
+    def test_every_registered_metric_is_documented(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        documented = self._documented_names(root)
+        registered = self._registered_names(root)
+        # the scan must actually see the catalog and the registrations
+        assert len(documented) > 30 and len(registered) > 30
+        missing = sorted({
+            f"{name} ({where})"
+            for name, where in registered if name not in documented
+        })
+        assert not missing, (
+            "metric family name(s) registered in elephas_tpu/ but "
+            "absent from the docs/API.md catalog — document them (or "
+            "tag the registration with 'metric-doc: allow <reason>'):"
+            "\n" + "\n".join(missing)
+        )
+
+    def test_brace_expansion(self):
+        assert {
+            "elephas_serving_slo_met_total",
+            "elephas_serving_slo_missed_total",
+        } <= self._expand_braces("elephas_serving_slo_{met,missed}_total")
+        assert self._expand_braces("elephas_fleet_up") == {
+            "elephas_fleet_up"
+        }
+        # shorthand + label selector on one token: both names resolve
+        assert {
+            "elephas_prefix_cache_hits_total",
+            "elephas_prefix_cache_misses_total",
+        } <= self._expand_braces(
+            "elephas_prefix_cache_{hits,misses}_total{cache}"
+        )
+
+
 class TestTelemetryWallClockLint:
     """ISSUE 5 satellite: the telemetry determinism contract says wall
     time is EXPORT-ONLY — control paths order themselves by logical
@@ -415,7 +524,16 @@ class TestTelemetryWallClockLint:
         files.append(os.path.join(
             root, "elephas_tpu", "telemetry", "registry.py"
         ))
-        assert all(os.path.exists(f) for f in files[-2:])
+        # ISSUE 13: the watchdog/aggregator/merge layer evaluates and
+        # re-renders observability state — its cadence is the
+        # caller's; an ad-hoc wall-clock comparison inside it would be
+        # exactly the telemetry-drives-behavior leak the contract
+        # bans. Pinned by name like the serving modules.
+        for mod in ("watch.py", "aggregate.py", "merge.py"):
+            files.append(os.path.join(
+                root, "elephas_tpu", "telemetry", mod
+            ))
+        assert all(os.path.exists(f) for f in files[-5:])
         offences = []
         for path in files:
             with open(path) as f:
@@ -458,6 +576,16 @@ class TestTelemetryWallClockLint:
             os.path.join(root, "elephas_tpu", "serving", "*.py")
         ))
         assert len(files) > 8
+        # ISSUE 13: the new fleet-observability modules carry the same
+        # capture-at-construction contract — a Watchdog/FleetScraper
+        # that re-resolved null mode per evaluate()/poll() would fork
+        # what it was built to record; pinned by name so a rename
+        # cannot drop them
+        for mod in ("watch.py", "aggregate.py", "merge.py"):
+            files.append(os.path.join(
+                root, "elephas_tpu", "telemetry", mod
+            ))
+        assert all(os.path.exists(f) for f in files[-3:])
         offences = []
         for path in files:
             with open(path) as f:

@@ -1,0 +1,935 @@
+"""Fleet-wide observability (ISSUE 13): cross-process trace
+propagation, the Chrome-trace merge tool, the fleet metrics
+aggregator, and the anomaly watchdogs.
+
+Acceptance contract: a minted trace id propagates over the PS wire
+(socket op + HTTP header; legacy peers are clean no-ops) so worker
+pushes, server applies, and journal writes share one id; the merge
+tool aligns per-process exports into one pid/tid-rowed timeline where
+one trace id spans gateway → engine and worker → PS → journal write;
+the FleetScraper exposes ≥2 instances' series under one /metrics with
+``instance=`` labels and no source mutation; and the watchdog rules
+fire/clear on their documented truth tables, detect a PS shard kill
+(right shard label) and a deliberate engine stall end-to-end via the
+chaos harness, and are provably inert under telemetry null mode.
+"""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elephas_tpu import telemetry
+from elephas_tpu.telemetry import merge as trace_merge
+from elephas_tpu.telemetry.aggregate import FleetScraper, parse_exposition
+from elephas_tpu.telemetry.registry import Registry
+from elephas_tpu.telemetry.watch import (
+    BlocksExhaustedRule,
+    DecodeStallRule,
+    HeartbeatStaleRule,
+    JournalLagRule,
+    PsUnreachableRule,
+    QueueStallRule,
+    SloBurnRule,
+    SpecCollapseRule,
+    Watchdog,
+)
+
+WEIGHTS = lambda: [np.zeros((4, 4), np.float32)]  # noqa: E731
+DELTA = lambda: [np.ones((4, 4), np.float32)]  # noqa: E731
+
+
+# -- trace context --------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_scope_set_restore_and_nesting(self):
+        assert telemetry.current_trace() is None
+        with telemetry.trace_scope("outer"):
+            assert telemetry.current_trace() == "outer"
+            with telemetry.trace_scope("inner"):
+                assert telemetry.current_trace() == "inner"
+            assert telemetry.current_trace() == "outer"
+        assert telemetry.current_trace() is None
+
+    def test_none_scope_is_passthrough(self):
+        """trace_scope(None) must NOT clear an ambient scope — the
+        worker's inherit-the-caller shape depends on it."""
+        with telemetry.trace_scope("ambient"):
+            with telemetry.trace_scope(None):
+                assert telemetry.current_trace() == "ambient"
+
+    def test_thread_local(self):
+        seen = {}
+
+        def probe():
+            seen["other"] = telemetry.current_trace()
+
+        with telemetry.trace_scope("mine"):
+            t = threading.Thread(target=probe)
+            t.start()
+            t.join()
+        assert seen["other"] is None
+
+    def test_events_auto_stamp_and_explicit_wins(self):
+        tracer = telemetry.tracer()
+        seq0 = tracer.seq
+        with telemetry.trace_scope("t-1"):
+            tracer.emit("fleettest.instant", x=1)
+            with tracer.span("fleettest.span"):
+                pass
+            tracer.emit("fleettest.explicit", trace="mine")
+        tracer.emit("fleettest.outside")
+        events = {
+            e["name"]: e for e in tracer.events(since_seq=seq0)
+            if e["name"].startswith("fleettest.")
+        }
+        assert events["fleettest.instant"]["args"]["trace"] == "t-1"
+        assert events["fleettest.span"]["args"]["trace"] == "t-1"
+        assert events["fleettest.explicit"]["args"]["trace"] == "mine"
+        assert "trace" not in events["fleettest.outside"]["args"]
+
+    def test_null_mode_scope_harmless(self):
+        prev = telemetry.set_null(True)
+        try:
+            with telemetry.trace_scope("nulled"):
+                assert telemetry.emit("fleettest.nulled") == -1
+        finally:
+            telemetry.set_null(prev)
+
+
+# -- wire propagation -----------------------------------------------------
+
+
+class TestWirePropagation:
+    @pytest.mark.parametrize("transport", ["socket", "http"])
+    def test_trace_spans_push_apply_journal(self, transport, tmp_path):
+        from elephas_tpu.parameter.client import HttpClient, SocketClient
+        from elephas_tpu.parameter.server import HttpServer, SocketServer
+
+        server_cls, client_cls = {
+            "socket": (SocketServer, SocketClient),
+            "http": (HttpServer, HttpClient),
+        }[transport]
+        server = server_cls(
+            WEIGHTS(), port=0,
+            journal_dir=str(tmp_path / transport), journal_every=1,
+        )
+        server.start()
+        tracer = telemetry.tracer()
+        seq0 = tracer.seq
+        client = client_cls(master=f"127.0.0.1:{server.port}")
+        try:
+            with telemetry.trace_scope("deploy-9"):
+                client.update_parameters(DELTA())
+                client.get_parameters()
+                client.flush()
+            client.update_parameters(DELTA())  # outside any scope
+            client.flush()
+        finally:
+            client.close()
+            server.stop()
+        events = tracer.events(since_seq=seq0)
+        applies = [e for e in events if e["name"] == "ps.apply"]
+        journals = [e for e in events if e["name"] == "ps.journal_write"]
+        pushes = [e for e in events if e["name"] == "ps.push"]
+        assert applies[0]["args"]["trace"] == "deploy-9"
+        assert applies[0]["args"]["applied"] is True
+        assert journals[0]["args"]["trace"] == "deploy-9"
+        assert pushes[0]["args"]["trace"] == "deploy-9"
+        # the push span carries the (cid, seq) alignment edge
+        assert pushes[0]["args"]["cid"] == client.client_id
+        assert pushes[0]["args"]["seq"] == 0
+        # the out-of-scope op cleared the forwarded context
+        assert "trace" not in applies[-1]["args"]
+
+    def test_legacy_socket_peer_clean_noop(self, monkeypatch):
+        """A protocol-2 server must never see the T op (it would
+        sever on the unknown byte): the client gates on the probed
+        version, ops keep working, nothing is stamped."""
+        from elephas_tpu.parameter import server as server_mod
+        from elephas_tpu.parameter.client import SocketClient
+
+        monkeypatch.setattr(server_mod, "PROTOCOL_VERSION", 2)
+        server = server_mod.SocketServer(WEIGHTS(), port=0)
+        server.start()
+        tracer = telemetry.tracer()
+        seq0 = tracer.seq
+        client = SocketClient(master=f"127.0.0.1:{server.port}")
+        try:
+            assert client._proto_version == 2
+            assert not client._traceful
+            with telemetry.trace_scope("legacy-run"):
+                client.update_parameters(DELTA())
+                out = client.get_parameters()
+                client.flush()
+            assert client._conn_trace is None  # T was never sent
+        finally:
+            client.close()
+            server.stop()
+        np.testing.assert_allclose(out[0], np.ones((4, 4)))
+        applies = [
+            e for e in tracer.events(since_seq=seq0)
+            if e["name"] == "ps.apply"
+        ]
+        assert applies and all(
+            "trace" not in e["args"] for e in applies
+        )
+
+    def test_sharded_client_propagates_with_shard_labels(self):
+        from elephas_tpu.parameter.client import ShardedClient
+        from elephas_tpu.parameter.server import SocketServer
+        from elephas_tpu.parameter.sharding import ShardedServerGroup
+
+        weights = [
+            np.zeros((4, 4), np.float32), np.zeros((8,), np.float32)
+        ]
+        group = ShardedServerGroup(SocketServer, weights, 2)
+        group.start()
+        tracer = telemetry.tracer()
+        seq0 = tracer.seq
+        client = ShardedClient(group.endpoints, group.shard_map)
+        try:
+            with telemetry.trace_scope("sharded-deploy"):
+                client.update_parameters(
+                    [np.ones_like(w) for w in weights]
+                )
+                client.flush()
+        finally:
+            client.close()
+            group.stop()
+        applies = [
+            e for e in tracer.events(since_seq=seq0)
+            if e["name"] == "ps.apply"
+            and e["args"].get("trace") == "sharded-deploy"
+        ]
+        # both shards applied under the same propagated id
+        servers = {e["args"]["server"] for e in applies}
+        assert len(servers) == 2
+
+
+# -- scrape parity --------------------------------------------------------
+
+
+class TestScrapeParity:
+    def test_socket_server_scrape_own_vs_full(self):
+        from elephas_tpu.parameter.server import SocketServer
+
+        a = SocketServer(WEIGHTS(), port=0)
+        b = SocketServer(WEIGHTS(), port=0)
+        try:
+            own = a.scrape()
+            assert f'server="{a.telemetry_label}"' in own
+            assert f'server="{b.telemetry_label}"' not in own
+            assert "elephas_ps_updates_applied_total" in own
+            full = a.scrape(full=True)
+            assert f'server="{b.telemetry_label}"' in full
+        finally:
+            a.release_telemetry()
+            b.release_telemetry()
+
+    def test_sharded_group_scrape_all(self):
+        from elephas_tpu.parameter.server import SocketServer
+        from elephas_tpu.parameter.sharding import ShardedServerGroup
+
+        weights = [
+            np.zeros((4, 4), np.float32), np.zeros((8,), np.float32)
+        ]
+        group = ShardedServerGroup(SocketServer, weights, 2)
+        texts = group.scrape_all()
+        assert sorted(texts) == [0, 1]
+        for i, server in enumerate(group.servers):
+            assert f'server="{server.telemetry_label}"' in texts[i]
+            assert f'shard="{i}"' in texts[i]  # shard_info joins
+            server.release_telemetry()
+
+    def test_native_server_scrape(self):
+        import shutil
+
+        if shutil.which("g++") is None:
+            pytest.skip("no C++ toolchain")
+        from elephas_tpu.parameter.native import NativeParameterServer
+
+        server = NativeParameterServer(WEIGHTS(), port=0)
+        try:
+            own = server.scrape()
+            assert "elephas_ps_store_bytes" in own
+            assert f'server="{server.telemetry_label}"' in own
+            # 4x4 f32 = 64 bytes
+            assert "elephas_ps_store_bytes{server=" in own
+            fleet = FleetScraper({"native": server})
+            fleet.poll()
+            # 4x4 f32 = 64 bytes, readable through the aggregator
+            assert fleet.value(
+                "elephas_ps_store_bytes", instance="native"
+            ) == 64.0
+            fleet.release_telemetry()
+        finally:
+            server.stop()
+            server.release_telemetry()
+
+
+# -- trace merge ----------------------------------------------------------
+
+
+def _span(name, ts_us, dur_us, **args):
+    return {
+        "name": name, "ph": "X", "pid": 1, "tid": 1,
+        "ts": float(ts_us), "dur": float(dur_us), "args": args,
+    }
+
+
+class TestMerge:
+    def test_alignment_from_push_apply_edge(self, tmp_path):
+        """Two exports whose clocks disagree by 1s: the apply nested
+        inside the push round-trip bounds the offset; the merged
+        timeline places the apply INSIDE the push window."""
+        skew = 1_000_000.0  # 1s in µs
+        client_trace = [
+            _span("ps.push", 10_000, 30_000, cid="w1", seq=5,
+                  client="0"),
+        ]
+        server_trace = [
+            _span("ps.apply", 20_000 + skew, 5_000, client_id="w1",
+                  seq=5, server="1"),
+            _span("ps.journal_write", 26_000 + skew, 1_000, server="1"),
+        ]
+        a, b = tmp_path / "client.json", tmp_path / "server.json"
+        a.write_text(json.dumps({"traceEvents": client_trace}))
+        b.write_text(json.dumps({"traceEvents": server_trace}))
+        doc = trace_merge.merge_chrome_traces([str(a), str(b)])
+        off = doc["elephas_fleet"]["offsets_us"]
+        assert off[0] == 0.0
+        # feasible interval: [10000-(20000+skew), 40000-(25000+skew)]
+        # = [-skew-10000, -skew+15000] -> midpoint -skew+2500
+        assert abs(off[1] - (-skew + 2500)) < 1.0
+        merged_apply = trace_merge.spans(doc, "ps.apply")[0]
+        push = trace_merge.spans(doc, "ps.push")[0]
+        assert push["ts"] <= merged_apply["ts"]
+        assert merged_apply["ts"] + merged_apply["dur"] \
+            <= push["ts"] + push["dur"]
+
+    def test_rows_labels_and_rid_normalization(self, tmp_path):
+        events = [
+            _span("gateway.request", 0, 10_000, route="POST /v1/generate",
+                  gateway="0", rid=7),
+            _span("ps.push", 0, 1_000, client="3", cid="w", seq=0),
+            {"name": "serve.submit", "ph": "i", "pid": 1, "tid": 2,
+             "ts": 1.0, "args": {"rid": 7}},
+            {"name": "chaos.ps_kill", "ph": "i", "pid": 1, "tid": 2,
+             "ts": 2.0, "args": {"port": 1}},
+        ]
+        p = tmp_path / "one.json"
+        p.write_text(json.dumps({"traceEvents": events}))
+        out = tmp_path / "merged.json"
+        doc = trace_merge.merge_chrome_traces(
+            [str(p)], out=str(out), labels=["proc-a"]
+        )
+        assert out.exists()
+        evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+        by_name = {e["name"]: e for e in evs}
+        # rid normalization: gateway and engine share ONE trace id
+        assert by_name["gateway.request"]["args"]["trace"] == "rid-7"
+        assert by_name["serve.submit"]["args"]["trace"] == "rid-7"
+        assert "rid-7" in doc["elephas_fleet"]["trace_ids"]
+        # every event carries the instance label
+        assert all(e["args"]["instance"] == "proc-a" for e in evs)
+        # component rows exist as thread_name metadata
+        rows = {
+            e["args"]["name"] for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "thread_name"
+        }
+        assert {"gateway-0", "ps-client-3", "serving", "chaos"} <= rows
+
+    def test_sharded_duplicate_edge_keys_skipped_not_misaligned(
+        self, tmp_path
+    ):
+        """The sharded client shares one client_id across shards with
+        per-shard seq counters: a worker export holds one ps.push per
+        shard under the SAME (cid, seq). Pairing either against one
+        shard's apply would silently corrupt the offset — ambiguous
+        keys must be dropped (offset falls back to 0), never
+        guessed."""
+        worker_trace = [
+            _span("ps.push", 10_000, 5_000, cid="w0", seq=0,
+                  client="0"),
+            _span("ps.push", 50_000, 5_000, cid="w0", seq=0,
+                  client="1"),  # other shard, same (cid, seq)
+        ]
+        shard_trace = [
+            _span("ps.apply", 900_000, 1_000, client_id="w0", seq=0,
+                  server="2"),
+        ]
+        a, b = tmp_path / "w.json", tmp_path / "s.json"
+        a.write_text(json.dumps({"traceEvents": worker_trace}))
+        b.write_text(json.dumps({"traceEvents": shard_trace}))
+        doc = trace_merge.merge_chrome_traces([str(a), str(b)])
+        assert doc["elephas_fleet"]["offsets_us"] == [0.0, 0.0]
+
+    def test_unconnected_inputs_keep_zero_offset(self, tmp_path):
+        p1 = tmp_path / "a.json"
+        p2 = tmp_path / "b.json"
+        p1.write_text(json.dumps(
+            {"traceEvents": [_span("x", 0, 1, engine="0")]}
+        ))
+        p2.write_text(json.dumps(
+            {"traceEvents": [_span("y", 0, 1, engine="1")]}
+        ))
+        doc = trace_merge.merge_chrome_traces([str(p1), str(p2)])
+        assert doc["elephas_fleet"]["offsets_us"] == [0.0, 0.0]
+
+
+@pytest.mark.slow  # subprocess python -m invocation
+class TestMergeCli:
+    def test_module_cli_smoke(self, tmp_path):
+        a = tmp_path / "a.json"
+        a.write_text(json.dumps({
+            "traceEvents": [_span("ps.push", 0, 10, client="0",
+                                  cid="w", seq=0)]
+        }))
+        out = tmp_path / "fleet.json"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, "-m", "elephas_tpu.telemetry.merge",
+             str(a), "-o", str(out), "--labels", "worker"],
+            capture_output=True, text=True, timeout=300, cwd=repo,
+        )
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        assert "merged 1 trace(s)" in proc.stdout
+        doc = json.loads(out.read_text())
+        assert doc["elephas_fleet"]["inputs"] == ["worker"]
+
+
+# -- fleet scraper --------------------------------------------------------
+
+
+class TestFleetScraper:
+    def test_two_instances_one_exposition_no_source_mutation(self):
+        from elephas_tpu.parameter.server import SocketServer
+
+        a = SocketServer(WEIGHTS(), port=0)
+        b = SocketServer(WEIGHTS(), port=0)
+        a.apply_update(DELTA())
+        before = a.scrape()
+        fleet = FleetScraper({"ps-a": a, "ps-b": b})
+        assert fleet.poll() == {"ps-a": True, "ps-b": True}
+        text = fleet.render()
+        assert 'instance="ps-a"' in text and 'instance="ps-b"' in text
+        assert "elephas_fleet_up" in text
+        assert a.scrape() == before  # sources untouched
+        assert fleet.value(
+            "elephas_ps_updates_applied_total", instance="ps-a"
+        ) == 1.0
+        stats = fleet.fleet_stats()
+        assert stats["ps-a"]["up"] and stats["ps-b"]["up"]
+        # the merged exposition parses back cleanly (round-trip)
+        parsed = parse_exposition(text)
+        fam = parsed["elephas_ps_updates_applied_total"]
+        instances = {
+            labels["instance"] for _n, labels, _v in fam.samples
+        }
+        assert instances == {"ps-a", "ps-b"}
+        fleet.release_telemetry()
+        a.release_telemetry()
+        b.release_telemetry()
+
+    def test_http_target_and_serve_endpoint(self):
+        from elephas_tpu.parameter.server import HttpServer
+
+        server = HttpServer(WEIGHTS(), port=0)
+        server.start()
+        fleet = FleetScraper(
+            {"ps-http": f"http://127.0.0.1:{server.port}/metrics"}
+        )
+        try:
+            assert fleet.poll() == {"ps-http": True}
+            assert 'instance="ps-http"' in fleet.render()
+            fleet.serve(port=0)
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", fleet.port, timeout=30
+            )
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            assert resp.status == 200
+            assert 'instance="ps-http"' in body
+            conn.request("GET", "/fleet")
+            resp = conn.getresponse()
+            stats = json.loads(resp.read())
+            assert stats["ps-http"]["up"] is True
+            conn.close()
+        finally:
+            fleet.stop()
+            server.stop()
+            fleet.release_telemetry()
+            server.release_telemetry()
+
+    def test_dead_target_serves_stale_view_and_up_zero(self):
+        from elephas_tpu.parameter.server import HttpServer
+
+        server = HttpServer(WEIGHTS(), port=0)
+        server.start()
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        fleet = FleetScraper({"ps": url}, poll_on_render=False)
+        assert fleet.poll() == {"ps": True}
+        server.stop()  # the member dies
+        assert fleet.poll() == {"ps": False}
+        text = fleet.render()
+        # stale view still present, up gauge reads 0
+        assert 'instance="ps"' in text
+        assert fleet.value(
+            "elephas_fleet_up", instance="ps",
+            fleet=fleet.telemetry_label,
+        ) == 0.0 or 'elephas_fleet_up{fleet="' in text
+        stats = fleet.fleet_stats()
+        assert stats["ps"]["up"] is False
+        assert stats["ps"]["families"] > 0  # stale families retained
+        fleet.release_telemetry()
+
+    def test_duplicate_label_refused(self):
+        fleet = FleetScraper({"a": lambda: ""})
+        with pytest.raises(ValueError, match="duplicate"):
+            fleet.add_target("a", lambda: "")
+        fleet.release_telemetry()
+
+    def test_exported_instance_renamed(self):
+        text = (
+            "# TYPE some_metric gauge\n"
+            'some_metric{instance="inner"} 4\n'
+        )
+        fleet = FleetScraper({"outer": lambda: text})
+        fleet.poll()
+        out = fleet.render()
+        assert 'instance="outer"' in out
+        assert 'exported_instance="inner"' in out
+        fleet.release_telemetry()
+
+
+# -- watchdog truth tables ------------------------------------------------
+
+
+def _registry_with(*specs):
+    """Fresh registry with labeled series: specs are
+    (kind, name, labels_dict, value)."""
+    reg = Registry()
+    handles = {}
+    for kind, name, labels, value in specs:
+        fam = getattr(reg, kind)(name, "", labels=tuple(labels))
+        child = fam.labels(**labels) if labels else fam
+        if kind == "gauge":
+            child.set(value)
+        elif value:
+            child.inc(value)
+        handles[(name,) + tuple(sorted(labels.items()))] = child
+    return reg, handles
+
+
+class TestWatchdogRules:
+    def test_queue_stall_fires_and_clears(self):
+        reg = Registry()
+        waiting = reg.gauge(
+            "elephas_serving_waiting_requests", "",
+            labels=("scheduler",),
+        ).labels(scheduler="5")
+        adm = reg.counter(
+            "elephas_serving_admissions_total", "",
+            labels=("scheduler", "kind"),
+        ).labels(scheduler="5", kind="cold")
+        w = Watchdog(source=reg, rules=[QueueStallRule(patience=2)])
+        waiting.set(4)
+        assert w.evaluate() == []  # baseline sighting
+        assert w.evaluate() == []  # streak 1
+        fired = w.evaluate()       # streak 2
+        assert fired[0].rule == "queue_stall"
+        assert fired[0].labels == {"scheduler": "5"}
+        assert fired[0].severity == "critical"
+        adm.inc()                  # admissions move again
+        assert w.evaluate() == []
+        rep = w.report()
+        assert rep["fired_total"] == 1 and rep["cleared_total"] == 1
+
+    def test_queue_draining_never_fires(self):
+        reg = Registry()
+        waiting = reg.gauge(
+            "elephas_serving_waiting_requests", "",
+            labels=("scheduler",),
+        ).labels(scheduler="1")
+        reg.counter(
+            "elephas_serving_admissions_total", "",
+            labels=("scheduler", "kind"),
+        ).labels(scheduler="1", kind="cold")
+        w = Watchdog(source=reg, rules=[QueueStallRule(patience=1)])
+        for depth in (5, 4, 3, 2, 1, 0):  # shrinking = healthy drain
+            waiting.set(depth)
+            assert w.evaluate() == []
+
+    def test_decode_stall(self):
+        reg = Registry()
+        tokens = reg.counter(
+            "elephas_serving_tokens_generated_total", "",
+            labels=("engine",),
+        ).labels(engine="0")
+        waiting = reg.gauge(
+            "elephas_serving_waiting_requests", "",
+            labels=("scheduler",),
+        ).labels(scheduler="0")
+        w = Watchdog(source=reg, rules=[DecodeStallRule(patience=2)])
+        waiting.set(2)
+        tokens.inc(10)
+        assert w.evaluate() == []  # baseline
+        assert w.evaluate() == []  # streak 1
+        assert w.evaluate()[0].rule == "decode_stall"  # streak 2
+        tokens.inc()               # a token landed: clears
+        assert w.evaluate() == []
+        # no waiting work = never a stall, however quiet
+        waiting.set(0)
+        for _ in range(4):
+            assert w.evaluate() == []
+
+    def test_slo_burn(self):
+        reg = Registry()
+        met = reg.counter(
+            "elephas_serving_slo_met_total", "",
+            labels=("engine", "tenant"),
+        ).labels(engine="0", tenant="light")
+        missed = reg.counter(
+            "elephas_serving_slo_missed_total", "",
+            labels=("engine", "tenant"),
+        ).labels(engine="0", tenant="light")
+        w = Watchdog(
+            source=reg,
+            rules=[SloBurnRule(threshold=0.5, min_events=4)],
+        )
+        assert w.evaluate() == []  # baseline
+        met.inc(3)
+        missed.inc(1)              # 25% miss: under threshold
+        assert w.evaluate() == []
+        missed.inc(4)              # this window: 0 met, 4 missed
+        a = w.evaluate()
+        assert a[0].rule == "slo_burn"
+        assert a[0].labels["tenant"] == "light"
+        assert w.evaluate() == []  # clean next window clears
+        met.inc(1)
+        missed.inc(1)              # only 2 events: below min_events
+        assert w.evaluate() == []
+
+    def test_journal_lag_and_heartbeat_stale(self):
+        reg = Registry()
+        lag = reg.gauge(
+            "elephas_ps_journal_lag_updates", "", labels=("server",)
+        ).labels(server="2")
+        age = reg.gauge(
+            "elephas_ps_oldest_heartbeat_age_seconds", "",
+            labels=("server",),
+        ).labels(server="2")
+        w = Watchdog(source=reg, rules=[
+            JournalLagRule(max_lag=10), HeartbeatStaleRule(max_age_s=5),
+        ])
+        lag.set(3)
+        age.set(1.0)
+        assert w.evaluate() == []
+        lag.set(10)
+        age.set(6.0)
+        fired = w.evaluate()
+        assert {a.rule for a in fired} == {
+            "journal_lag", "heartbeat_stale"
+        }
+        assert all(a.labels == {"server": "2"} for a in fired)
+        # a dead server's weakref gauge reads NaN: no data, not a fire
+        lag.set(float("nan"))
+        age.set(float("nan"))
+        assert w.evaluate() == []
+
+    def test_blocks_exhausted_escalates_on_rejections(self):
+        reg = Registry()
+        free = reg.gauge(
+            "elephas_serving_blocks_free", "", labels=("engine",)
+        ).labels(engine="3")
+        reg.gauge(
+            "elephas_serving_kv_blocks", "", labels=("engine",)
+        ).labels(engine="3").set(100)
+        rejected = reg.counter(
+            "elephas_serving_rejected_total", "", labels=("engine",)
+        ).labels(engine="3")
+        w = Watchdog(
+            source=reg, rules=[BlocksExhaustedRule(free_frac=0.02)]
+        )
+        free.set(50)
+        assert w.evaluate() == []
+        free.set(1)                # 1% free
+        a = w.evaluate()
+        assert a[0].rule == "blocks_exhausted"
+        assert a[0].severity == "warn"
+        rejected.inc(3)            # now requests are bouncing
+        a = w.evaluate()
+        assert a[0].severity == "critical"
+        free.set(60)
+        assert w.evaluate() == []
+
+    def test_spec_collapse(self):
+        reg = Registry()
+        drafted = reg.counter(
+            "elephas_serving_spec_draft_tokens_total", "",
+            labels=("engine",),
+        ).labels(engine="0")
+        accepted = reg.counter(
+            "elephas_serving_spec_accepted_tokens_total", "",
+            labels=("engine",),
+        ).labels(engine="0")
+        w = Watchdog(
+            source=reg,
+            rules=[SpecCollapseRule(floor=0.1, min_drafted=64)],
+        )
+        assert w.evaluate() == []  # baseline
+        drafted.inc(100)
+        accepted.inc(80)           # healthy
+        assert w.evaluate() == []
+        drafted.inc(100)
+        accepted.inc(2)            # collapsed window
+        assert w.evaluate()[0].rule == "spec_collapse"
+        drafted.inc(10)            # under min_drafted: no verdict
+        assert w.evaluate() == []
+
+    def test_ps_unreachable_hysteresis_and_refire(self):
+        reg = Registry()
+        pauses = reg.counter(
+            "elephas_ps_client_shard_pauses_total", "",
+            labels=("client", "shard"),
+        ).labels(client="9", shard="1")
+        w = Watchdog(
+            source=reg, rules=[PsUnreachableRule(clear_after=2)]
+        )
+        assert w.evaluate() == []  # baseline
+        pauses.inc()
+        a = w.evaluate()
+        assert a[0].rule == "ps_unreachable"
+        assert a[0].labels == {"client": "9", "shard": "1"}
+        # quiet 1: hysteresis holds the anomaly active
+        assert w.evaluate()[0].rule == "ps_unreachable"
+        # quiet 2: clears
+        assert w.evaluate() == []
+        rep = w.report()
+        assert rep["fired_total"] == 1 and rep["cleared_total"] == 1
+        pauses.inc()               # second outage re-fires fresh
+        assert w.evaluate()[0].rule == "ps_unreachable"
+
+    def test_report_ranks_critical_first(self):
+        reg = Registry()
+        reg.gauge(
+            "elephas_ps_journal_lag_updates", "", labels=("server",)
+        ).labels(server="0").set(999)
+        lost = reg.gauge(
+            "elephas_ps_client_updates_lost", "", labels=("client",)
+        ).labels(client="0")
+        lost.set(2)
+        w = Watchdog(source=reg, rules=[
+            JournalLagRule(max_lag=10), PsUnreachableRule(),
+        ])
+        active = w.evaluate()
+        assert [a.severity for a in active] == ["critical", "warn"]
+        rep = w.report()
+        assert rep["critical"] == 1 and rep["warn"] == 1
+        assert rep["active"][0]["rule"] == "ps_unreachable"
+
+    def test_null_mode_watchdog_is_inert(self):
+        tracer = telemetry.default_tracer()
+        seq0 = tracer.seq
+        prev = telemetry.set_null(True)
+        try:
+            w = Watchdog()
+            for _ in range(5):
+                assert w.evaluate() == []
+            assert w.report()["active"] == []
+        finally:
+            telemetry.set_null(prev)
+        # nothing landed on the real trace stream either
+        assert tracer.events(since_seq=seq0, name="watch.anomaly") == []
+        # and it stays inert even after null mode flips back off
+        # (capture-at-construction)
+        assert w.evaluate() == []
+
+    def test_shared_rule_instance_refused(self):
+        rule = JournalLagRule()
+        with pytest.raises(ValueError, match="twice"):
+            Watchdog(source=Registry(), rules=[rule, rule])
+
+    def test_watchdog_over_fleet_scraper(self):
+        """The fleet-wide shape: rules read the aggregated view, so
+        one watchdog covers N instances (labels carry instance=)."""
+        text = (
+            "# TYPE elephas_ps_journal_lag_updates gauge\n"
+            'elephas_ps_journal_lag_updates{server="0"} 500\n'
+        )
+        fleet = FleetScraper(
+            {"ps-x": lambda: text}, poll_on_render=False
+        )
+        fleet.poll()
+        w = Watchdog(source=fleet, rules=[JournalLagRule(max_lag=10)])
+        a = w.evaluate()
+        assert a and a[0].labels["server"] == "0"
+        fleet.release_telemetry()
+
+
+# -- end-to-end: chaos harness + gateway ----------------------------------
+
+
+@pytest.mark.slow  # trains a small keras model against live sockets
+class TestChaosWatchIntegration:
+    def test_shard_kill_fires_labeled_anomaly_then_clears(self, tmp_path):
+        from elephas_tpu.fault.harness import run_sharded_chaos_training
+        from elephas_tpu.fault.plan import FaultPlan
+
+        plan = FaultPlan(
+            seed=0, kill_ps_after_updates=2, restart_delay_s=0.75,
+            kill_shard=0,
+        )
+        out = run_sharded_chaos_training(
+            "socket", num_shards=2, rows=256, epochs=2, batch_size=64,
+            plan=plan, journal_dir=str(tmp_path / "j"), watch=True,
+            trace_export=str(tmp_path / "trace.json"),
+        )
+        anomalies = out["watch_anomalies"]
+        # the kill surfaced as ps_unreachable with the killed shard's
+        # label...
+        assert any(
+            a["rule"] == "ps_unreachable" and a.get("shard") == "0"
+            for a in anomalies
+        ), anomalies
+        # ...and cleared on recovery (nothing left active)
+        assert any(
+            a["rule"] == "ps_unreachable" for a in out["watch_cleared"]
+        )
+        assert out["watch_report"]["active"] == []
+        # the run's trace id spans worker push -> apply -> journal
+        doc = json.loads((tmp_path / "trace.json").read_text())
+        tid = out["trace_id"]
+        for name in ("ps.push", "ps.apply", "ps.journal_write"):
+            assert any(
+                e["name"] == name and e["args"].get("trace") == tid
+                for e in doc["traceEvents"]
+            ), name
+
+
+class TestGatewayWatchdogAndTrace:
+    @pytest.fixture(scope="class")
+    def gw(self, serving_lm):
+        from elephas_tpu.serving import Gateway, InferenceEngine
+
+        engine = InferenceEngine(serving_lm, num_slots=2)
+        gateway = Gateway(engine, port=0).start()
+        yield gateway
+        gateway.stop()
+        engine.close()
+        gateway.release_telemetry()
+        engine.release_telemetry()
+
+    @staticmethod
+    def _get(port, path):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", port, timeout=60
+        )
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, json.loads(data)
+
+    def _healthz_anomalies(self, gw):
+        _status, body = self._get(gw.port, "/healthz")
+        assert "anomalies" in body  # the ISSUE-13 healthz detail
+        return body["anomalies"]
+
+    def test_engine_stall_detected_and_cleared(self, gw):
+        from elephas_tpu.fault.harness import EngineStaller
+
+        engine = gw.engine
+        # warm: one request through, healthz clean
+        done = threading.Event()
+        with gw._engine_lock:
+            engine.submit(
+                [2, 3, 4], 3,
+                on_token=lambda t, d: done.set() if d else None,
+            )
+        gw._work.set()
+        assert done.wait(120)
+        assert self._healthz_anomalies(gw)["critical"] == 0
+
+        with EngineStaller(engine):
+            with gw._engine_lock:
+                engine.submit([3, 4, 5], 3)  # queues; stalled step
+            gw._work.set()
+            deadline = time.monotonic() + 60
+            rules = set()
+            while time.monotonic() < deadline:
+                report = self._healthz_anomalies(gw)
+                rules = {
+                    a["rule"] for a in report["active"]
+                }
+                if {"decode_stall", "queue_stall"} & rules:
+                    break
+                time.sleep(0.05)
+            assert {"decode_stall", "queue_stall"} & rules, rules
+        # stall released: the queued request drains and probes clear
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            report = self._healthz_anomalies(gw)
+            if not report["active"]:
+                break
+            time.sleep(0.05)
+        assert report["active"] == []
+        assert gw.watchdog.report()["cleared_total"] >= 1
+
+    def test_merged_trace_single_id_gateway_to_engine(self, gw, tmp_path):
+        tracer = telemetry.default_tracer()
+        seq0 = tracer.seq
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", gw.port, timeout=120
+        )
+        conn.request(
+            "POST", "/v1/generate",
+            body=json.dumps({
+                "prompt": [2, 3, 4, 5], "max_new_tokens": 3,
+                "stream": False,
+            }),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        rid = body["rid"]
+        # the buffered JSON response can land before the engine's
+        # serve.finish instant is appended — wait for it briefly
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if any(
+                e["args"].get("rid") == rid
+                for e in tracer.events(
+                    since_seq=seq0, name="serve.finish"
+                )
+            ):
+                break
+            time.sleep(0.02)
+        raw = tmp_path / "gw.json"
+        tracer.export_chrome_trace(str(raw), since_seq=seq0)
+        doc = trace_merge.merge_chrome_traces(
+            [str(raw)], labels=["gateway-proc"]
+        )
+        trace_id = f"rid-{rid}"
+        names = {
+            e["name"]
+            for e in doc["traceEvents"]
+            if (e.get("args") or {}).get("trace") == trace_id
+        }
+        # ONE id spans the gateway request span and the engine's
+        # lifecycle events for the same request
+        assert "gateway.request" in names, sorted(names)
+        assert "serve.submit" in names
+        assert "serve.first_token" in names and "serve.finish" in names
+        assert trace_id in doc["elephas_fleet"]["trace_ids"]
