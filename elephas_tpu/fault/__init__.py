@@ -26,6 +26,7 @@ from elephas_tpu.fault.plan import (  # noqa: F401
 )
 from elephas_tpu.fault.harness import (  # noqa: F401
     PSKiller,
+    ReplicaKiller,
     RestartablePS,
     ShardKiller,
     ShardedRestartablePS,

@@ -314,6 +314,53 @@ class WatchdogPoller:
         self._thread.join(timeout=10)
 
 
+class ReplicaKiller(threading.Thread):
+    """Kill one fleet-router serving replica mid-stream (ISSUE 14
+    chaos): a daemon thread watches the router's PLAIN delivered-token
+    counter (host truth, not a registry series — the trigger never
+    reads telemetry) and, once the fleet has streamed
+    ``after_tokens`` tokens, abandons the named replica through
+    ``router.kill_replica()`` — driver dead, engine state lost,
+    exactly a crashed process — which re-drives the survivors.
+    Telemetry is still REQUIRED: the kill's evidence trail (the
+    ``chaos.replica_kill`` instant, the router's replica-up gauge the
+    ``replica_down`` watchdog rule fires on) is the point of running
+    chaos at all.
+
+    ``killed`` is set after the kill; ``redriven`` records how many
+    in-flight requests moved. Like :class:`PSKiller`, the trigger is a
+    COUNT, not a wall-clock timer: the same workload kills at the same
+    logical point on any box speed."""
+
+    def __init__(self, router, replica: str, after_tokens: int = 8,
+                 poll_s: float = 0.005):
+        super().__init__(name="elephas-replica-killer", daemon=True)
+        _require_telemetry("ReplicaKiller")
+        self.router = router
+        self.replica = str(replica)
+        self.after_tokens = int(after_tokens)
+        self.poll_s = float(poll_s)
+        self.killed = threading.Event()
+        self.redriven: int | None = None
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            if self.router.tokens_delivered >= self.after_tokens:
+                telemetry.emit(
+                    "chaos.replica_kill", replica=self.replica,
+                    after_tokens=self.after_tokens,
+                )
+                self.redriven = self.router.kill_replica(self.replica)
+                self.killed.set()
+                return
+            self._halt.wait(self.poll_s)
+
+    def cancel(self) -> None:
+        self._halt.set()
+        self.join(timeout=15)
+
+
 # -- sharded chaos (ISSUE 6) ---------------------------------------------
 
 

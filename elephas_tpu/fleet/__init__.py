@@ -1,0 +1,55 @@
+"""Serving fleet: replicated engines behind a prefix- and load-aware
+router, with cross-replica live migration (ISSUE 14 tentpole).
+
+The tier above one :class:`~elephas_tpu.serving.engine.\
+InferenceEngine` — the piece the north-star's "millions of users"
+needs once a single engine saturates:
+
+- :mod:`elephas_tpu.fleet.placement` — deterministic two-stage
+  placement as a PURE function: prefix affinity first (route to the
+  replica whose cache already holds the prompt's longest warm prefix,
+  above a ``min_affinity_tokens`` floor), load balance the rest by
+  blocks-free/queue-depth, round-robin as the counted degraded floor
+  when the fleet view goes stale. Same snapshot + same prompt ⇒ same
+  replica, on every call and every process.
+- :mod:`elephas_tpu.fleet.migration` — the cross-replica live-
+  migration wire format (v1): PR 7's preemption offload record
+  (dense K/V block rows + cursor/last-token snapshot) plus the
+  request's identity/knobs/trace context, framed as binary +
+  JSON-header (no pickle). A request preempted on replica A resumes
+  **bit-exact at temperature 0** on replica B.
+- :mod:`elephas_tpu.fleet.router` — :class:`~elephas_tpu.fleet.\
+router.Router`: N replicas (each its own driver thread/lock/arena)
+  behind one placement brain and an optional asyncio HTTP/1.1 + SSE
+  front door (the ``serving/gateway.py`` idiom). ``drain()`` empties
+  a replica for deploys by live-migrating its work (zero dropped,
+  zero doubled tokens); ``kill_replica()`` + re-drive is the chaos
+  story (survivors continue every in-flight stream from its last
+  delivered token, straggler-guarded); the ``replica_down`` watchdog
+  rule (:mod:`elephas_tpu.telemetry.watch`) fires and clears off the
+  router's replica-up gauge.
+"""
+
+from elephas_tpu.fleet.migration import (  # noqa: F401
+    decode_record,
+    encode_record,
+)
+from elephas_tpu.fleet.placement import (  # noqa: F401
+    PlacementDecision,
+    place,
+)
+from elephas_tpu.fleet.router import (  # noqa: F401
+    Replica,
+    Router,
+    RouterRequest,
+)
+
+__all__ = [
+    "PlacementDecision",
+    "place",
+    "encode_record",
+    "decode_record",
+    "Replica",
+    "Router",
+    "RouterRequest",
+]

@@ -1,0 +1,139 @@
+"""Cross-replica live-migration wire format (ISSUE 14).
+
+PR 7's preemption offload record — dense per-layer K/V block rows plus
+the ``(cursor, last token)`` snapshot that makes greedy resume a pure
+function — IS a serializable live-migration format; this module gives
+it a versioned binary encoding so a preempted request can travel
+between replicas (``engine.export_request`` → wire →
+``engine.import_request``) and resume **bit-exact at temperature 0**
+on a different engine serving identical weights.
+
+Layout (v1, little-endian)::
+
+    b"EMIG" | u16 version | u32 header_len | header JSON | array bytes
+
+The header is the engine's export payload minus the arrays: request
+identity (rid, trace context), prompt + generated tokens,
+budget/sampling/tenant knobs, and the resume cursor state
+(``cur_len``, ``n_blocks``, ``block_size``) plus per-layer array specs
+(name, shape, dtype) in sorted-name order. The arrays follow as raw
+``tobytes()`` in that exact order (k then v per layer), so decoding is
+``frombuffer`` + ``reshape`` — a bitwise round-trip, no re-encoding,
+no quantization, and **no pickle** (the PR-2 wire-module rule: framed
+binary + JSON headers only).
+
+Cold records (``n_blocks == 0``) carry no arrays: the target replica
+re-prefills from the prompt — the right shape for requests that were
+still waiting or mid-prefill when exported.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+__all__ = ["MAGIC", "VERSION", "encode_record", "decode_record"]
+
+MAGIC = b"EMIG"
+VERSION = 1
+
+_HEAD = struct.Struct("<HI")  # version, header length
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype by NAME — bf16 (and friends) resolve through
+    ml_dtypes exactly like the parameter-server codec does."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_record(record: dict) -> bytes:
+    """Serialize one engine export payload (the dict
+    :meth:`~elephas_tpu.serving.engine.InferenceEngine.export_request`
+    returns) into the v1 wire format."""
+    rows = record.get("rows") or {}
+    layers = []
+    blobs: list[bytes] = []
+    for name in sorted(rows):
+        k, v = rows[name]
+        k = np.ascontiguousarray(k)
+        v = np.ascontiguousarray(v)
+        layers.append({
+            "name": str(name),
+            "k_shape": list(k.shape), "k_dtype": k.dtype.name,
+            "v_shape": list(v.shape), "v_dtype": v.dtype.name,
+        })
+        blobs.append(k.tobytes())
+        blobs.append(v.tobytes())
+    header = {key: val for key, val in record.items() if key != "rows"}
+    header["version"] = VERSION
+    header["layers"] = layers
+    hb = json.dumps(header).encode("utf-8")
+    out = bytearray(MAGIC)
+    out += _HEAD.pack(VERSION, len(hb))
+    out += hb
+    for blob in blobs:
+        out += blob
+    return bytes(out)
+
+
+def decode_record(data) -> dict:
+    """Parse v1 wire bytes back into the engine's import payload
+    shape. Raises ``ValueError`` loudly on a bad magic, unknown
+    version, or truncated/oversized array section — a torn migration
+    must never resume as silent garbage."""
+    mv = memoryview(data)
+    if len(mv) < 4 + _HEAD.size or bytes(mv[:4]) != MAGIC:
+        raise ValueError(
+            "not a migration record (bad magic — expected EMIG)"
+        )
+    version, hlen = _HEAD.unpack_from(mv, 4)
+    if version != VERSION:
+        raise ValueError(
+            f"migration record version {version} unsupported (this "
+            f"codec speaks v{VERSION})"
+        )
+    off = 4 + _HEAD.size
+    if off + hlen > len(mv):
+        raise ValueError("truncated migration record header")
+    try:
+        header = json.loads(bytes(mv[off:off + hlen]).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ValueError(f"corrupt migration record header: {e}")
+    off += hlen
+    rows = {}
+    for spec in header.pop("layers", []):
+        kd = _np_dtype(spec["k_dtype"])
+        vd = _np_dtype(spec["v_dtype"])
+        k_shape = tuple(int(s) for s in spec["k_shape"])
+        v_shape = tuple(int(s) for s in spec["v_shape"])
+        k_count = int(np.prod(k_shape, dtype=np.int64))
+        v_count = int(np.prod(v_shape, dtype=np.int64))
+        need = k_count * kd.itemsize + v_count * vd.itemsize
+        if off + need > len(mv):
+            raise ValueError(
+                f"truncated migration record: layer "
+                f"{spec['name']!r} needs {need} more bytes"
+            )
+        k = np.frombuffer(
+            mv, dtype=kd, count=k_count, offset=off
+        ).reshape(k_shape)
+        off += k_count * kd.itemsize
+        v = np.frombuffer(
+            mv, dtype=vd, count=v_count, offset=off
+        ).reshape(v_shape)
+        off += v_count * vd.itemsize
+        rows[spec["name"]] = (k, v)
+    if off != len(mv):
+        raise ValueError(
+            f"migration record carries {len(mv) - off} trailing "
+            f"bytes — torn write or mismatched header"
+        )
+    header["rows"] = rows
+    return header

@@ -57,7 +57,10 @@ blocks` — the paged arena (ISSUE 7, ``serve(paged=True)``): a global
 """
 
 from elephas_tpu.serving.blocks import BlockAllocator  # noqa: F401
-from elephas_tpu.serving.engine import InferenceEngine  # noqa: F401
+from elephas_tpu.serving.engine import (  # noqa: F401
+    InferenceEngine,
+    RequestCancelled,
+)
 from elephas_tpu.serving.prefix_cache import (  # noqa: F401
     PagedPrefixIndex,
     PrefixCache,

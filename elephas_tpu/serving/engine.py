@@ -80,6 +80,12 @@ from elephas_tpu.serving.scheduler import (
 logger = logging.getLogger(__name__)
 
 
+class RequestCancelled(RuntimeError):
+    """Set as ``req.error`` when :meth:`InferenceEngine.cancel`
+    reclaims an in-flight request (ISSUE 14): the request is ``done``
+    without completing, its tokens-so-far kept for the caller."""
+
+
 class _OffloadRecord:
     """Host-side K/V of a preempted request: dense block rows per
     layer (``{name: (k, v)}``, each ``[n_blocks, block_size, H, Dh]``
@@ -654,6 +660,24 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "elephas_serving_admission_rejected_total",
             "Requests rejected at submit by the policy's overload "
             "admission control (429 on the gateway)",
+        )
+        # lifecycle control (ISSUE 14): cancellation + live migration.
+        # Counters exist in every mode (stats() keys never vary by
+        # config); engines outside a fleet simply never migrate.
+        self._m_cancelled = _c(
+            "elephas_serving_cancelled_total",
+            "In-flight requests cancelled before completion "
+            "(slot/blocks reclaimed; gateway client disconnects land "
+            "here)",
+        )
+        self._m_migrated_out = _c(
+            "elephas_serving_migrated_out_total",
+            "Requests exported off this engine as migration records "
+            "(fleet drain / rebalancing)",
+        )
+        self._m_migrated_in = _c(
+            "elephas_serving_migrated_in_total",
+            "Requests adopted from another replica's migration record",
         )
 
         def _tc(name, help_):
@@ -2660,6 +2684,355 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             self._evict_finished()  # deferred trim, still loud
         return drained
 
+    # -- lifecycle control: cancel + live migration (ISSUE 14) ---------
+
+    def _detach(self, req: Request, reason: str) -> None:
+        """Shared bookkeeping for a request leaving the engine before
+        completion (cancel / migration export): policy + spec-throttle
+        accounting drop and the flight record files with ``reason``."""
+        if self.policy is not None:
+            self.policy.on_finish(req)
+        if self._spec_throttle is not None:
+            self._spec_throttle.forget(req.rid)
+        self._fr_finish(req, reason)
+
+    def _find_slot(self, rid: int) -> int | None:
+        return next(
+            (s for s, r in self.scheduler.active.items()
+             if r.rid == rid),
+            None,
+        )
+
+    def _notify_stream_end(self, req: Request) -> None:
+        """Tell a request's live stream it ENDED without a final
+        engine token — ``on_token(None, True)``. Without this, a
+        consumer blocking on the token stream (the gateway's SSE/JSON
+        handlers) waits forever when the request is cancelled or
+        migrated away mid-flight: those paths flip ``req.done``
+        without ever invoking the callback."""
+        cb = req.on_token
+        if cb is not None:
+            try:
+                cb(None, True)
+            except BaseException:
+                logger.warning(
+                    "request %d stream-end callback failed",
+                    req.rid, exc_info=True,
+                )
+
+    def cancel(self, rid: int) -> bool:
+        """Abort one in-flight request and reclaim its slot/blocks
+        NOW — a disconnected SSE client's request must not decode to
+        completion into a queue nobody reads (the gateway wires client
+        aborts here; the router's re-drive path uses it too). Works on
+        every engine config: a waiting request just leaves the queue, a
+        preempted one drops its host offload record, an active one
+        frees its slot (and block table, paged) at the next step
+        boundary — deterministic host bookkeeping only, no device
+        program runs. Returns True when the rid was live (its
+        ``req.done`` flips True with ``req.error`` set to
+        :class:`RequestCancelled`; generated-so-far tokens are kept),
+        False when it was unknown or already finished.
+
+        Gang contract: like :meth:`submit`, every gang process must
+        issue the identical cancel sequence at the identical step
+        boundaries — cancellation reshapes the admission schedule."""
+        rid = int(rid)
+        sched = self.scheduler
+        req = sched.remove_waiting(rid)
+        if req is not None:
+            # a preempted victim waiting to resume also drops its
+            # host-offloaded K/V here
+            self._offloaded.pop(rid, None)
+        else:
+            slot = self._find_slot(rid)
+            if slot is None:
+                return False
+            req = sched.active[slot]
+            self._prefilling.pop(slot, None)
+            self._stale_prefill.discard(slot)
+            sched.reclaim(slot)
+            self._set_active(slot, False)
+        req.done = True
+        req.error = RequestCancelled(f"request {rid} cancelled")
+        # a live stream must UNBLOCK, not hang: cancel never delivers
+        # a final token, so send the explicit end sentinel
+        self._notify_stream_end(req)
+        self._m_cancelled.inc()
+        self._tracer.emit(
+            "serve.cancel", rid=rid, tokens=len(req.tokens),
+            step=sched._steps,
+        )
+        self._detach(req, "cancelled")
+        self.finished[rid] = req
+        self._evict_finished()
+        return True
+
+    def export_request(self, rid: int, *,
+                       notify_stream: bool = False) -> dict:
+        """Freeze one live request and hand back its **migration
+        record** (ISSUE 14): a host-native dict — prompt, generated
+        tokens, budget/sampling/tenant knobs, and (warm path) the
+        preemption offload rows (dense per-layer K/V blocks) plus the
+        cursor state — that :meth:`import_request` on ANOTHER replica
+        resumes bit-exact at temperature 0. PR 7's offload record IS
+        the serialization format; this method just detaches it from
+        the engine. The request leaves this engine entirely (policy
+        accounting dropped, flight record filed as ``migrated`` — it
+        is NOT in ``finished``, it lives on elsewhere).
+
+        Warm export (K/V travels) needs a paged engine and a request
+        holding at least one generated token; waiting, mid-prefill,
+        and tokenless requests export COLD (the target re-prefills —
+        nothing resident is worth moving). An in-flight fixed-arena
+        request with tokens refuses loudly: the fixed arena has no
+        block-granular gather. Raises ``KeyError`` for a rid that is
+        not live here. Wire encoding lives in
+        :mod:`elephas_tpu.fleet.migration`.
+
+        ``notify_stream=True`` sends the exported request's live
+        ``on_token`` stream the ``(None, True)`` end sentinel — the
+        wire-migration shape (gateway ``/v1/requests/{rid}/export``),
+        where no callback travels and a local consumer blocking on
+        the stream would otherwise hang forever. The in-process fleet
+        router keeps the default: it re-attaches the SAME stream on
+        import, so the tokens must keep flowing to it."""
+        rid = int(rid)
+        sched = self.scheduler
+        store = self._offloaded.pop(rid, None)
+        if store is not None:
+            # already preempted: its offload record is the migration
+            # payload, ready-made (victims always wait in the queue)
+            req = sched.remove_waiting(rid)
+            assert req is not None  # preempted ⇒ waiting, invariant
+            return self._export_payload(
+                req, store, notify_stream=notify_stream
+            )
+        slot = self._find_slot(rid)
+        if slot is not None:
+            req = sched.active[slot]
+            if slot not in self._prefilling and req.tokens:
+                if not self.paged:
+                    raise ValueError(
+                        f"cannot warm-export in-flight request {rid} "
+                        f"from a fixed-arena engine — block offload "
+                        f"needs paged=True (cancel it or let it finish)"
+                    )
+                # force-preempt regardless of priority: drain has
+                # authority pressure never does. The engine offloads
+                # the device rows to host, then the record detaches
+                # through the _offloaded branch above.
+                pre = sched._preempt(req)
+                self._offload(pre)
+                return self.export_request(
+                    rid, notify_stream=notify_stream
+                )
+            # mid-prefill / tokenless: partial rows are not a resumable
+            # state — cold export, target prefills from scratch
+            self._prefilling.pop(slot, None)
+            self._stale_prefill.discard(slot)
+            sched.reclaim(slot)
+            self._set_active(slot, False)
+            return self._export_payload(
+                req, None, notify_stream=notify_stream
+            )
+        req = sched.remove_waiting(rid)
+        if req is None:
+            raise KeyError(f"request {rid} is not live on this engine")
+        return self._export_payload(
+            req, None, notify_stream=notify_stream
+        )
+
+    def _export_payload(self, req: Request, store, *,
+                        notify_stream: bool = False) -> dict:
+        self._detach(req, "migrated")
+        if notify_stream:
+            self._notify_stream_end(req)
+        self._m_migrated_out.inc()
+        self._tracer.emit(
+            "serve.export", rid=req.rid, warm=store is not None,
+            n_blocks=0 if store is None else store.n_blocks,
+            tokens=len(req.tokens), step=self.scheduler._steps,
+        )
+        return {
+            "version": 1,
+            "rid": int(req.rid),
+            "prompt": [int(t) for t in req.prompt],
+            "tokens": [int(t) for t in req.tokens],
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(req.temperature),
+            "eos_id": None if req.eos_id is None else int(req.eos_id),
+            "priority": int(req.priority),
+            "tenant": req.tenant,
+            "ttft_deadline_ms": req.ttft_deadline_ms,
+            # trace context rides the record so the migrated half of
+            # the lifecycle joins the same story on a merged timeline
+            "trace": telemetry.current_trace(),
+            "block_size": self.block_size,
+            "cur_len": 0 if store is None else store.cur_len,
+            "n_blocks": 0 if store is None else store.n_blocks,
+            "rows": {} if store is None else dict(store.rows),
+        }
+
+    def import_request(self, record: dict, on_token=None) -> Request:
+        """Adopt a migration record exported by another replica
+        (ISSUE 14). A warm record (``n_blocks > 0``) re-enters through
+        the preemption-resume path: the K/V rows park as a host
+        offload record, the request waits at the queue FRONT, and the
+        next admission scatters the rows into a fresh block table and
+        re-arms the cursor — bit-exact at temperature 0 by the same
+        argument as local preempt/resume (greedy decode is a pure
+        function of weights + K/V + cursor + last token; replicas
+        serve identical weights). A cold record is an ordinary
+        re-submission. ``on_token`` re-attaches the caller's stream
+        (callbacks never travel on the wire). Temp>0 streams re-key on
+        THIS engine's PRNG stream — deterministic per config, but not
+        the source engine's continuation (same caveat as chunked
+        prefill).
+
+        Validates loudly: version, maxlen fit, rid not already live
+        here, tenant known to this engine's policy, and — warm —
+        paged target, matching block size/geometry, and the
+        ``cur_len == prompt + generated - 1`` resume invariant."""
+        if int(record.get("version", -1)) != 1:
+            raise ValueError(
+                f"unknown migration record version "
+                f"{record.get('version')!r} (this engine speaks v1)"
+            )
+        sched = self.scheduler
+        rid = int(record["rid"])
+        prompt = tuple(int(t) for t in record["prompt"])
+        tokens = [int(t) for t in record["tokens"]]
+        max_new = int(record["max_new_tokens"])
+        if not prompt:
+            raise ValueError("migration record has an empty prompt")
+        if len(prompt) + max_new > self.maxlen:
+            raise ValueError(
+                f"record needs prompt ({len(prompt)}) + budget "
+                f"({max_new}) <= maxlen ({self.maxlen})"
+            )
+        if (
+            rid in self._offloaded
+            or rid in self.finished
+            or any(r.rid == rid for r in sched.waiting)
+            or any(r.rid == rid for r in sched.active.values())
+        ):
+            # exactly-once: live rids always refuse; served rids
+            # refuse for as long as the BOUNDED finished registry
+            # remembers them (best-effort replay guard — the wire
+            # protocol's real guarantee is that export detaches the
+            # record from its source exactly once)
+            raise ValueError(
+                f"request {rid} is already live (or was already "
+                f"served) on this engine — a record must be imported "
+                f"exactly once"
+            )
+        tenant = record.get("tenant")
+        if tenant is not None and (
+            self.policy is None or not self.policy.knows(tenant)
+        ):
+            raise ValueError(
+                f"record carries tenant {tenant!r} unknown to this "
+                f"engine's policy — fleet replicas must declare "
+                f"identical tenants"
+            )
+        rows = record.get("rows") or {}
+        n_blocks = int(record.get("n_blocks") or 0)
+        warm = n_blocks > 0
+        if not warm and tokens:
+            # a cold import re-prefills the PROMPT only: pre-set
+            # generated tokens would interleave with tokens decoded
+            # from a context that never saw them, and silently eat
+            # the budget — no legitimate export produces this shape
+            raise ValueError(
+                f"cold record (n_blocks=0) carries {len(tokens)} "
+                f"generated tokens — token-holding requests must "
+                f"export WARM (K/V travels) or not at all"
+            )
+        if warm:
+            if not self.paged:
+                raise ValueError(
+                    "warm migration record needs a paged target engine"
+                )
+            if int(record["block_size"]) != self.block_size:
+                raise ValueError(
+                    f"record block_size {record['block_size']} != this "
+                    f"engine's {self.block_size} — K/V blocks are not "
+                    f"geometry-portable"
+                )
+            if not tokens:
+                raise ValueError(
+                    "warm record without generated tokens — the resume "
+                    "cursor math (last token re-arm) would be wrong"
+                )
+            cur_len = int(record["cur_len"])
+            if cur_len != len(prompt) + len(tokens) - 1:
+                raise ValueError(
+                    f"corrupt record: cur_len {cur_len} != prompt "
+                    f"({len(prompt)}) + generated ({len(tokens)}) - 1"
+                )
+            if n_blocks != blocks_for(cur_len, self.block_size):
+                raise ValueError(
+                    f"corrupt record: {n_blocks} blocks cannot cover "
+                    f"cur_len {cur_len} at block_size {self.block_size}"
+                )
+            expected = {name for name, _h, _d in self.arena.specs}
+            if set(rows) != expected:
+                raise ValueError(
+                    f"record layers {sorted(rows)} != this engine's "
+                    f"{sorted(expected)} — different model architecture"
+                )
+            if blocks_for(
+                len(prompt) + max_new, self.block_size
+            ) > self.num_blocks:
+                raise ValueError(
+                    f"record can never fit this pool ({self.num_blocks}"
+                    f" blocks) — route it to a larger replica"
+                )
+        req = Request(
+            rid=rid, prompt=prompt, max_new_tokens=max_new,
+            temperature=float(record.get("temperature") or 0.0),
+            eos_id=(
+                None if record.get("eos_id") is None
+                else int(record["eos_id"])
+            ),
+            priority=int(record.get("priority") or 0),
+            tenant=tenant,
+            ttft_deadline_ms=record.get("ttft_deadline_ms"),
+            tokens=tokens,
+            on_token=on_token,
+        )
+        req.submit_step = sched._steps
+        # TTFT was (or will be) observed where the request FIRST ran;
+        # submit_time stays None here so a migrated request's next
+        # token never double-observes the TTFT histogram or SLO
+        # counters on the adopting engine
+        req.exemplar = {"rid": str(rid)}
+        rec = self._fr_new(req)
+        seq = self._tracer.emit(
+            "serve.import", rid=rid, warm=warm, n_blocks=n_blocks,
+            tokens=len(tokens), step=sched._steps,
+        )
+        if rec is not None:
+            rec["submit_seq"] = seq
+        if warm:
+            host_rows = {
+                name: (
+                    np.ascontiguousarray(k), np.ascontiguousarray(v)
+                )
+                for name, (k, v) in rows.items()
+            }
+            self._offloaded[rid] = _OffloadRecord(
+                rows=host_rows, n_blocks=n_blocks,
+                cur_len=int(record["cur_len"]),
+            )
+            sched.adopt_preempted(req, int(record["cur_len"]))
+        else:
+            # scheduler.submit handles the policy's on_submit hook
+            sched.submit(req)
+        self._m_migrated_in.inc()
+        return req
+
     # -- introspection -------------------------------------------------
 
     # Telemetry views (ISSUE 5 satellite): the registry counters are
@@ -2679,16 +3052,38 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
     def finished_evicted(self) -> int:
         return int(self._m_finished_evicted.value)
 
-    def scrape(self, openmetrics: bool = False) -> str:
+    def scrape(self, openmetrics: bool = False,
+               full: bool = True) -> str:
         """This engine's registry rendered as Prometheus exposition
         text (the in-process scrape surface; the HTTP surface is the
         parameter server's ``GET /metrics``). Empty when the engine was
         constructed under telemetry null mode. ``openmetrics=True``
         renders the OpenMetrics flavor instead — histogram buckets
         carry their rid exemplars (ISSUE 12), so a TTFT p99 spike
-        links straight to :meth:`explain`'s record of the request."""
+        links straight to :meth:`explain`'s record of the request.
+
+        ``full=False`` (ISSUE 14) narrows the exposition to THIS
+        engine's own series (its ``engine=`` labels plus its
+        scheduler's ``scheduler=`` labels) — the per-replica scrape
+        shape a :class:`~elephas_tpu.telemetry.aggregate.FleetScraper`
+        wants when several replicas share one process registry (a full
+        render would make every instance's fleet view identical sums).
+        Same ``only=`` filtering the PR-13 PS scrape-parity satellite
+        introduced; no new metrics plumbing."""
         if openmetrics:
+            if not full:
+                raise ValueError(
+                    "full=False is a 0.0.4-flavor filter — the "
+                    "OpenMetrics surface renders the whole registry"
+                )
             return telemetry.render_openmetrics(self._telemetry_registry)
+        if not full:
+            reg = self._telemetry_registry
+            return telemetry.render(
+                reg, only={"engine": self.telemetry_label}
+            ) + telemetry.render(
+                reg, only={"scheduler": self.scheduler.telemetry_label}
+            )
         return telemetry.render(self._telemetry_registry)
 
     def prefix_warm_probe(self, prompt) -> int:
@@ -2979,6 +3374,11 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             # can never drift
             "admission_rejected": int(self._m_admission_rejected.value),
             "tenants": self._tenant_stats(),
+            # lifecycle control (ISSUE 14): registry-backed like the
+            # rest — stats() and a /metrics scrape read the same series
+            "cancelled": int(self._m_cancelled.value),
+            "migrated_out": int(self._m_migrated_out.value),
+            "migrated_in": int(self._m_migrated_in.value),
         }
         if self.policy is not None:
             out["policy"] = self.policy.stats()

@@ -129,6 +129,7 @@ class Gateway:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  read_timeout: float = READ_TIMEOUT,
                  max_body: int = MAX_BODY,
+                 max_migrate_body: int = 1 << 28,
                  health_stall_grace: float = 120.0,
                  watchdog=None):
         self.engine = engine
@@ -137,6 +138,9 @@ class Gateway:
         self.port: int | None = None
         self.read_timeout = float(read_timeout)
         self.max_body = int(max_body)
+        # migration records carry dense K/V blocks — orders of
+        # magnitude bigger than a generate body; own bound (ISSUE 14)
+        self.max_migrate_body = int(max_migrate_body)
         # /healthz stall detection (ISSUE 12): grace window before
         # "has work but steps are not advancing" reports 503. A
         # first-request XLA compile legitimately freezes steps for a
@@ -410,6 +414,8 @@ class Gateway:
                 pass  # fault-lint: allow — already-severed transport
 
     _TRACE_PATH = re.compile(r"^/v1/requests/(\d+)/trace$")
+    _CANCEL_PATH = re.compile(r"^/v1/requests/(\d+)/cancel$")
+    _EXPORT_PATH = re.compile(r"^/v1/requests/(\d+)/export$")
 
     @classmethod
     def _route_label(cls, method: str, path: str) -> str:
@@ -423,10 +429,14 @@ class Gateway:
         bare = path.split("?", 1)[0]
         if method == "GET" and cls._TRACE_PATH.match(bare):
             return "GET /v1/requests/:rid/trace"
+        if method == "POST" and cls._CANCEL_PATH.match(bare):
+            return "POST /v1/requests/:rid/cancel"
+        if method == "POST" and cls._EXPORT_PATH.match(bare):
+            return "POST /v1/requests/:rid/export"
         route = f"{method} {bare}"
         if route in (
             "POST /v1/generate", "GET /metrics", "GET /stats",
-            "GET /healthz", "GET /debug/engine",
+            "GET /healthz", "GET /debug/engine", "POST /v1/migrate",
         ):
             return route
         return "other"
@@ -461,9 +471,14 @@ class Gateway:
                 n = int(headers.get("content-length", "0"))
             except ValueError:
                 raise _HttpError(400, "bad Content-Length")
-            if n > self.max_body:
+            limit = (
+                self.max_migrate_body
+                if path.split("?", 1)[0] == "/v1/migrate"
+                else self.max_body
+            )
+            if n > limit:
                 raise _HttpError(
-                    413, f"body of {n} bytes exceeds {self.max_body}"
+                    413, f"body of {n} bytes exceeds {limit}"
                 )
             if n:
                 body = await reader.readexactly(n)
@@ -518,7 +533,106 @@ class Gateway:
             if method != "GET":
                 raise _HttpError(405, "GET only")
             return await self._request_trace(int(m.group(1)), writer)
+        m = self._CANCEL_PATH.match(path)
+        if m is not None:
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            return await self._cancel(int(m.group(1)), writer)
+        m = self._EXPORT_PATH.match(path)
+        if m is not None:
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            return await self._export(int(m.group(1)), writer)
+        if path == "/v1/migrate":
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            return await self._migrate(body, writer)
         raise _HttpError(404, f"no route {path}")
+
+    async def _cancel(self, rid: int, writer) -> int:
+        """``POST /v1/requests/{rid}/cancel`` — abort one in-flight
+        request and reclaim its slot/blocks (ISSUE 14). 404 when the
+        rid is unknown or already finished (nothing to reclaim)."""
+        loop = asyncio.get_running_loop()
+
+        def do_cancel():
+            with self._engine_lock:
+                return self.engine.cancel(rid)
+
+        if not await loop.run_in_executor(None, do_cancel):
+            raise _HttpError(
+                404, f"request {rid} is not in flight on this engine"
+            )
+        await self._write(writer, _json_response(
+            200, {"rid": rid, "cancelled": True},
+            extra_headers=(("X-Request-Id", str(rid)),),
+        ))
+        return 200
+
+    async def _export(self, rid: int, writer) -> int:
+        """``POST /v1/requests/{rid}/export`` — freeze one live
+        request and return its migration record as the v1 binary wire
+        format (ISSUE 14): the request LEAVES this engine; POST the
+        bytes to another replica's ``/v1/migrate`` to resume it
+        there. 404 for a rid that is not live here, 409 when the
+        request cannot be exported (fixed-arena warm export)."""
+        from elephas_tpu.fleet.migration import encode_record
+
+        loop = asyncio.get_running_loop()
+
+        def do_export():
+            with self._engine_lock:
+                # notify_stream: the request leaves THIS engine for
+                # good over the wire — a local SSE/JSON handler
+                # blocking on its token stream must end, not hang
+                record = self.engine.export_request(
+                    rid, notify_stream=True
+                )
+            # the encode is pure host work over an already-detached
+            # record — serializing potentially hundreds of MB of K/V
+            # rows must not stall the decode driver behind the lock
+            return encode_record(record)
+
+        try:
+            payload = await loop.run_in_executor(None, do_export)
+        except KeyError as e:
+            raise _HttpError(404, str(e).strip("'\""))
+        except ValueError as e:
+            raise _HttpError(409, str(e))
+        await self._write(writer, _response(
+            200, payload, "application/octet-stream",
+            extra_headers=(("X-Request-Id", str(rid)),),
+        ))
+        return 200
+
+    async def _migrate(self, body: bytes, writer) -> int:
+        """``POST /v1/migrate`` — adopt a migration record exported by
+        another replica (the drain/rebalance wire, ISSUE 14). The body
+        is the v1 binary record; the response confirms the adopted rid
+        and whether the K/V resumed warm. No token stream re-attaches
+        over this route (callbacks never travel) — the in-process
+        fleet router re-wires streams itself; a wire-migrated request
+        accumulates tokens readable via its trace/stats surfaces."""
+        from elephas_tpu.fleet.migration import decode_record
+
+        loop = asyncio.get_running_loop()
+
+        def do_import():
+            record = decode_record(body)
+            with self._engine_lock:
+                req = self.engine.import_request(record)
+                return req.rid, int(record.get("n_blocks") or 0) > 0
+
+        try:
+            rid, warm = await loop.run_in_executor(None, do_import)
+        except ValueError as e:
+            raise _HttpError(409, str(e))
+        self._work.set()  # wake the driver: the adoptee needs steps
+        await self._write(writer, _json_response(
+            200, {"rid": rid, "warm": warm},
+            extra_headers=(("X-Request-Id", str(rid)),),
+        ))
+        return 200
 
     async def _json_snapshot(self, writer, fn) -> int:
         """Serve ``fn()`` (engine introspection under the engine lock)
@@ -646,8 +760,12 @@ class Gateway:
         q: asyncio.Queue = asyncio.Queue()
 
         def on_token(token, done):
+            # token None is the stream-END sentinel (cancelled /
+            # migrated away without a final token) — forward it, the
+            # consumer loops end without appending
             loop.call_soon_threadsafe(
-                q.put_nowait, (int(token), bool(done))
+                q.put_nowait,
+                (None if token is None else int(token), bool(done)),
             )
 
         def do_submit():
@@ -699,7 +817,8 @@ class Gateway:
         tokens = []
         while True:
             token, done = await q.get()
-            tokens.append(token)
+            if token is not None:
+                tokens.append(token)
             if done:
                 return tokens
 
@@ -734,9 +853,11 @@ class Gateway:
             await self._write(writer, _sse_event({"rid": req.rid}))
             while True:
                 token, done = await q.get()
-                await self._write(
-                    writer, _sse_event({"token": token, "done": done})
-                )
+                if token is not None:
+                    await self._write(
+                        writer,
+                        _sse_event({"token": token, "done": done}),
+                    )
                 if done:
                     break
             final = {
@@ -746,13 +867,25 @@ class Gateway:
             }
             await self._write(writer, _sse_event(final, event="done"))
         except (ConnectionError, OSError) as e:
-            # client went away mid-stream: the engine finishes the
-            # request on its own (tokens drop into a queue nobody
-            # reads, freed with the handler) — log and close
+            # client went away mid-stream: CANCEL the request so its
+            # slot/blocks reclaim now (ISSUE 14 satellite — before
+            # this, a disconnected client's request decoded to
+            # completion into a queue nobody reads). Off-loop like
+            # every engine call; skipped during stop(), whose sever
+            # path also lands here — teardown must not queue cancels
+            # behind a lock the driver is about to release for good.
             logger.info(
                 "SSE client for request %d disconnected mid-stream "
-                "(%r)", req.rid, e,
+                "(%r) — cancelling", req.rid, e,
             )
+            if not self._stopping.is_set():
+                loop = asyncio.get_running_loop()
+
+                def do_cancel():
+                    with self._engine_lock:
+                        return self.engine.cancel(req.rid)
+
+                await loop.run_in_executor(None, do_cancel)
         finally:
             self._m_sse_active.dec()
         return 200

@@ -39,6 +39,22 @@ from elephas_tpu.serving.paged_kv import blocks_for
 from elephas_tpu.serving.prefix_cache import PagedPrefixIndex, PrefixCache
 
 
+# -- request-id minting (ISSUE 14 satellite) ---------------------------
+# Each scheduler mints rids from its OWN stride of the integer line:
+# the Nth scheduler constructed in this process starts at N * RID_STRIDE
+# (process-monotonic, no pids, no wall time — the same determinism
+# contract as telemetry.instance_label). Before this, every engine
+# counted from 0, so rids COLLIDED across engines within one process —
+# harmless for a single engine, a trace-reconstruction flake for test
+# combos with several, and outright wrong for the fleet router, which
+# keys in-flight requests, migration records, and re-drives by rid
+# across replicas. The stride leaves ~10^12 rids per engine; a gang of
+# processes running the identical construction + submission schedule
+# still derives identical rids on every process.
+RID_STRIDE = 1 << 40
+_rid_bases = itertools.count()
+
+
 def default_buckets(max_len: int, floor: int = 16) -> tuple[int, ...]:
     """Power-of-two prompt buckets ``[floor, 2·floor, ..]`` capped at
     (and always including) ``max_len``."""
@@ -189,7 +205,11 @@ class Scheduler:
         self.waiting: deque[Request] = deque()
         self.active: dict[int, Request] = {}
         self._free: list[int] = list(range(self.num_slots))
-        self._ids = itertools.count()
+        # rid base: this scheduler's own stride of the integer line —
+        # see RID_STRIDE above (rid uniqueness across engines is
+        # load-bearing for the fleet router)
+        self.rid_base = next(_rid_bases) * RID_STRIDE
+        self._ids = itertools.count(self.rid_base)
         # SLO admission policy (ISSUE 10): None keeps the bare-FIFO
         # fast path byte-for-byte; a policy gets the reorder/accounting
         # hooks documented in serving.policy
@@ -301,6 +321,36 @@ class Scheduler:
                 None if ttft_deadline_ms is None else float(ttft_deadline_ms)
             ),
         )
+
+    def remove_waiting(self, rid: int) -> Request | None:
+        """Pull one request out of the waiting queue by rid (cancel /
+        migration export): drops its token debt and any preemption
+        record; the caller owns the request — and its policy
+        accounting — from here. None when the rid is not waiting."""
+        req = next((r for r in self.waiting if r.rid == rid), None)
+        if req is None:
+            return None
+        self.waiting.remove(req)
+        self.queued_tokens -= self._debt(req)
+        self._preempted.pop(rid, None)
+        self._m_waiting.set(len(self.waiting))
+        return req
+
+    def adopt_preempted(self, req: Request, cur_len: int) -> None:
+        """Enqueue a request whose K/V the engine holds as a host
+        offload record (cross-replica migration import, ISSUE 14): it
+        waits at the FRONT like a locally-preempted victim and resumes
+        through the exact admission path preemption already uses —
+        ``admit_paged`` sees the preemption record and plans a resume
+        instead of a prefill."""
+        self._preempted[req.rid] = Preemption(
+            req=req, slot=-1, blocks=(), cur_len=int(cur_len),
+        )
+        self.waiting.appendleft(req)
+        self.queued_tokens += self._debt(req)
+        if self.policy is not None:
+            self.policy.on_submit(req)
+        self._m_waiting.set(len(self.waiting))
 
     def waiting_count(self, tenant: str) -> int:
         """Waiting requests accounted under ``tenant`` (the per-tenant

@@ -232,6 +232,26 @@ class FleetScraper:
         self._mf_scrapes.labels(fleet=self.telemetry_label, instance=label)
         self._mf_errors.labels(fleet=self.telemetry_label, instance=label)
 
+    def remove_target(self, label: str) -> None:
+        """Forget one member: its target, snapshot, and up-state drop,
+        and its meta series retire from the registry — the membership-
+        change shape (ISSUE 14: the fleet router swaps a replica's
+        scrape target when a dead replica is restored with a fresh
+        engine). Unknown labels raise loudly."""
+        label = str(label)
+        with self._lock:
+            if label not in self._targets:
+                raise KeyError(
+                    f"unknown fleet instance label {label!r} — have "
+                    f"{sorted(self._targets)}"
+                )
+            del self._targets[label]
+            self._snap.pop(label, None)
+            self._up.pop(label, None)
+        self._registry.remove_series(
+            fleet=self.telemetry_label, instance=label
+        )
+
     @property
     def instances(self) -> list[str]:
         with self._lock:

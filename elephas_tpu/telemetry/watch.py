@@ -54,6 +54,7 @@ __all__ = [
     "BlocksExhaustedRule",
     "SpecCollapseRule",
     "PsUnreachableRule",
+    "ReplicaDownRule",
     "default_rules",
 ]
 
@@ -519,6 +520,38 @@ class PsUnreachableRule(Rule):
         return out
 
 
+class ReplicaDownRule(Rule):
+    """A fleet router considers one of its serving replicas dead
+    (ISSUE 14): the router's ``elephas_router_replica_up`` gauge —
+    host-truth liveness the router maintains itself, set to 0 by
+    ``kill_replica``/a crashed driver and back to 1 by
+    ``restore_replica`` — reads 0. Active for exactly as long as the
+    gauge stays down, labeled with the precise replica, so the
+    fire/clear transitions bracket the outage on the anomaly
+    timeline. (Pure and stateless: the gauge IS the state.)"""
+
+    name = "replica_down"
+    severity = "critical"
+
+    def evaluate(self, read) -> list[Anomaly]:
+        out = []
+        for labels, value in read("elephas_router_replica_up"):
+            if not _finite(value) or value > 0:
+                continue
+            router = labels.get("router", "")
+            replica = labels.get("replica", "")
+            out.append(Anomaly(
+                self.name, self.severity,
+                {"router": router, "replica": replica},
+                value=value, threshold=1,
+                message=(
+                    f"router {router} lost replica {replica} — "
+                    f"placement is down to the survivors"
+                ),
+            ))
+        return out
+
+
 def default_rules() -> list[Rule]:
     """A fresh default catalog (rules are stateful — never share one
     list across watchdogs). Thresholds are the documented defaults;
@@ -532,6 +565,7 @@ def default_rules() -> list[Rule]:
         BlocksExhaustedRule(),
         SpecCollapseRule(),
         PsUnreachableRule(),
+        ReplicaDownRule(),
     ]
 
 

@@ -49,6 +49,7 @@ _PS_DEADLINE_MODULES = (
     "test_ps_sharding",
     "test_telemetry",
     "test_telemetry_fleet",
+    "test_fleet",
 )
 PS_TEST_DEADLINE_S = 120
 
