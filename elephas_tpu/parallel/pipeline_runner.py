@@ -643,6 +643,147 @@ def _partition_balanced(items: list, num_stages: int, weight_fn) -> list[list]:
     return groups
 
 
+# -- serving-shaped stage planning (ISSUE 15) ---------------------------
+#
+# The serving engine's PP path (serving/pp_engine.py) depth-shards a
+# causal LM over the SAME graph machinery training uses (_graph_nodes /
+# _segment_graph), but partitions by ATTENTION-LAYER count instead of
+# parameter bytes: each stage's per-layer KV pools stack into ONE
+# stage-sharded device buffer, so every stage must carry the same
+# number of FlashMHA layers (and identical head geometry). The plan is
+# pure host work — a deterministic function of the graph — so every
+# gang process derives the identical stage split.
+
+
+class ServingStagePlan:
+    """Depth split of a causal LM for pipeline-parallel SERVING.
+
+    ``programs[s]`` is stage ``s``'s node program ``(nodes, in_kt,
+    out_kt)`` (the training planner's shape); ``layers[s]`` its unique
+    keras layers; ``flash[s]`` its FlashMHA layers in graph order (the
+    stage's KV-pool slots — every stage holds exactly
+    ``len(flash[0])``); ``boundary_dims[i]`` the hidden width crossing
+    the ring after stage ``i`` (serving activations are per-position
+    ``[slots, D]`` rows, so every boundary must be a rank-3
+    ``[batch, seq, D]`` tensor in the traced graph)."""
+
+    def __init__(self, programs, layers, flash, boundary_dims):
+        self.programs = programs
+        self.layers = layers
+        self.flash = flash
+        self.boundary_dims = boundary_dims
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.programs)
+
+    def stage_summary(self) -> list[list[str]]:
+        return [[l.name for l in g] for g in self.layers]
+
+
+def plan_serving_stages(model, num_stages: int) -> ServingStagePlan:
+    """Serving-shaped stage planner (ISSUE 15): split ``model``'s
+    functional graph into ``num_stages`` depth stages at single-tensor
+    cut points, balanced so each stage carries exactly
+    ``num_flash_layers / num_stages`` attention layers.
+
+    Refuses loudly when the balance is impossible (layer count not a
+    multiple of ``num_stages``, or a graph segment bundling more
+    attention layers than one stage's quota), when a stage boundary is
+    not a rank-3 hidden tensor (the ring carries ``[slots, D]`` rows),
+    or when a weight-tied layer straddles the split (each stage uploads
+    its own weight copy — tying across stages would silently serve from
+    divergent copies)."""
+    from elephas_tpu.models.transformer import _flash_mha_layer
+
+    FlashMHA = _flash_mha_layer()
+    S = int(num_stages)
+    if S < 2:
+        raise ValueError(f"pipeline serving needs >= 2 stages, got {S}")
+    nodes, input_kt, output_kt = _graph_nodes(model)
+    segments = _segment_graph(nodes, input_kt, output_kt)
+
+    def _flash_count(seg_nodes) -> int:
+        return sum(
+            1 for l in _node_layers(seg_nodes)
+            if isinstance(l, FlashMHA)
+        )
+
+    total = _flash_count(nodes)
+    if total == 0 or total % S:
+        raise ValueError(
+            f"pipeline serving: {total} attention layers do not split "
+            f"evenly over {S} stages — per-stage KV pools stack into "
+            f"one stage-sharded buffer, so every stage must carry "
+            f"total/num_stages layers (use a layer count divisible by "
+            f"num_stages)"
+        )
+    quota = total // S
+    groups, cur, cnt = [], [], 0
+    for seg in segments:
+        cur.append(seg)
+        cnt += _flash_count(seg[0])
+        if cnt > quota:
+            raise ValueError(
+                f"pipeline serving: a graph segment bundles more than "
+                f"{quota} attention layers between single-tensor cut "
+                f"points — the graph cannot split into {S} "
+                f"equal-attention stages"
+            )
+        if cnt == quota and len(groups) < S - 1:
+            groups.append(cur)
+            cur, cnt = [], 0
+    groups.append(cur)
+    if len(groups) != S or _flash_count(
+        [n for seg in groups[-1] for n in seg[0]]
+    ) != quota:
+        raise ValueError(
+            f"pipeline serving: could not close {S} stages of {quota} "
+            f"attention layers each from the graph's cut points"
+        )
+
+    programs = [
+        (
+            [n for seg in g for n in seg[0]],
+            g[0][1],
+            g[-1][2],
+        )
+        for g in groups
+    ]
+    layers = [_node_layers(prog[0]) for prog in programs]
+    flash = [
+        [l for l in _node_layers(prog[0]) if isinstance(l, FlashMHA)]
+        for prog in programs
+    ]
+    # weight tying across the split would serve from per-stage copies
+    # that can silently diverge after a refresh — same refusal as the
+    # training planner
+    owner: dict[int, int] = {}
+    for si, group_layers in enumerate(layers):
+        for l in group_layers:
+            if id(l) in owner and owner[id(l)] != si:
+                raise ValueError(
+                    f"pipeline serving: layer {l.name!r} is reused at "
+                    f"graph nodes in stages {owner[id(l)]} and {si} "
+                    f"(weight tying across the split) — serve with "
+                    f"model_parallel instead"
+                )
+            owner[id(l)] = si
+    boundary_dims = []
+    for prog in programs[:-1]:
+        out_kt = prog[2]
+        shape = tuple(out_kt.shape)
+        if len(shape) != 3 or shape[2] is None:
+            raise ValueError(
+                f"pipeline serving: stage boundary tensor has shape "
+                f"{shape} — the decode ring carries per-position "
+                f"[slots, D] rows, so every boundary must be a rank-3 "
+                f"[batch, seq, D] hidden tensor"
+            )
+        boundary_dims.append(int(shape[2]))
+    return ServingStagePlan(programs, layers, flash, boundary_dims)
+
+
 class PipelineRunner:
     """``MeshRunner``-shaped facade that drives the GPipe trainer from a
     compiled Keras model (``SparkModel(pipeline_parallel=S)``)."""

@@ -61,6 +61,7 @@ from elephas_tpu.serving.engine import (  # noqa: F401
     InferenceEngine,
     RequestCancelled,
 )
+from elephas_tpu.serving.pp_engine import PPEngine  # noqa: F401
 from elephas_tpu.serving.prefix_cache import (  # noqa: F401
     PagedPrefixIndex,
     PrefixCache,

@@ -211,8 +211,12 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
     ingestion (``sp_mechanism="ring"`` has no head-count constraint;
     ``"ulysses"`` needs ``num_heads % axis_size == 0``).
 
-    PP ring decode is not integrated yet — construct via
-    ``SparkModel.serve()`` on a DP/TP mesh, or directly on no mesh.
+    Pipeline parallelism lives in its own engine (ISSUE 15):
+    :class:`~elephas_tpu.serving.pp_engine.PPEngine` runs continuous
+    batching over a PP×TP mesh with per-stage paged KV pools and
+    microbatched decode waves — construct THIS engine via
+    ``SparkModel.serve()`` on a DP/TP mesh (or directly on no mesh),
+    and the PP engine when model depth no longer fits one chip group.
     """
 
     def __init__(self, model, num_slots: int = 8, mesh=None,

@@ -13,6 +13,16 @@ notebook, useless for the "millions of users" north star. The
   ``data: {"token": t, "done": d}`` event per generated token after an
   opening ``data: {"rid": id}`` event, then the connection closes.
   ``stream: false`` buffers and returns one JSON document.
+  The BATCH form (ISSUE 15) carries ``"prompts": [[...], ...]``
+  instead of ``prompt``: every prompt is a normal ``submit()`` (the
+  policy and admission control judge each individually), answered as
+  one ``results`` JSON array or one rid-multiplexed SSE stream.
+- **HTTP keep-alive** (ISSUE 15 — the other half of ROADMAP item 2's
+  wire hardening): a ``Connection: keep-alive`` client (HTTP/1.1
+  default) gets its next request served off the same socket under a
+  bounded idle timeout (``keepalive_idle_timeout``, default 5s);
+  reuse is counted in ``elephas_gateway_connections_reused_total``.
+  SSE responses still own their connection to the end.
 - ``GET /metrics`` — the process registry through the PR-5 Prometheus
   renderer (the same text an in-process ``engine.scrape()`` returns);
   an ``Accept: application/openmetrics-text`` client gets the
@@ -93,22 +103,46 @@ _STATUS = {
 
 
 def _response(code: int, body: bytes, content_type: str,
-              extra_headers=()) -> bytes:
+              extra_headers=(), close: bool = True) -> bytes:
     head = [
         f"HTTP/1.1 {code} {_STATUS.get(code, 'Unknown')}",
         f"Content-Type: {content_type}",
         f"Content-Length: {len(body)}",
-        "Connection: close",
+        "Connection: close" if close else "Connection: keep-alive",
     ]
     head.extend(f"{k}: {v}" for k, v in extra_headers)
     return ("\r\n".join(head) + "\r\n\r\n").encode("ascii") + body
 
 
-def _json_response(code: int, obj, extra_headers=()) -> bytes:
+def _json_response(code: int, obj, extra_headers=(),
+                   close: bool = True) -> bytes:
     return _response(
         code, json.dumps(obj).encode("utf-8") + b"\n",
-        "application/json", extra_headers,
+        "application/json", extra_headers, close=close,
     )
+
+
+class _Conn:
+    """Per-connection keep-alive state (ISSUE 15 satellite): whether
+    the CURRENT response may leave the connection open. Handlers that
+    must own the socket to its end (SSE streams) flip ``persist``
+    off; everything else answers ``Connection: keep-alive`` when the
+    client asked for it and reads the next request off the same
+    socket under a bounded idle timeout."""
+
+    __slots__ = ("persist", "served")
+
+    def __init__(self):
+        self.persist = False
+        self.served = 0
+
+    def close_header(self) -> bool:
+        return not self.persist
+
+
+class _ConnectionClosed(Exception):
+    """EOF where a request line should start — a 400 on a fresh
+    connection, a clean goodbye on an idle keep-alive one."""
 
 
 class _HttpError(Exception):
@@ -131,6 +165,8 @@ class Gateway:
                  max_body: int = MAX_BODY,
                  max_migrate_body: int = 1 << 28,
                  health_stall_grace: float = 120.0,
+                 keepalive_idle_timeout: float = 5.0,
+                 max_batch_prompts: int = 64,
                  watchdog=None):
         self.engine = engine
         self.host = host
@@ -138,6 +174,17 @@ class Gateway:
         self.port: int | None = None
         self.read_timeout = float(read_timeout)
         self.max_body = int(max_body)
+        # HTTP keep-alive (ISSUE 15 satellite — ROADMAP item 2): a
+        # client that asks for it (HTTP/1.1 default) gets its next
+        # request served off the SAME connection, bounded by this idle
+        # timeout between requests (0 disables persistence outright).
+        # SSE streams still own their socket to the end.
+        self.keepalive_idle_timeout = float(keepalive_idle_timeout)
+        # /v1/generate batch form: one POST may carry up to this many
+        # prompts (each a NORMAL submit — policy/admission see them
+        # individually); bounded so a single request cannot flood the
+        # queue past what admission control can see coming
+        self.max_batch_prompts = int(max_batch_prompts)
         # migration records carry dense K/V blocks — orders of
         # magnitude bigger than a generate body; own bound (ISSUE 14)
         self.max_migrate_body = int(max_migrate_body)
@@ -188,6 +235,12 @@ class Gateway:
         self._m_sse_active = reg.gauge(
             "elephas_gateway_sse_active",
             "SSE token streams currently open",
+            labels=("gateway",),
+        ).labels(gateway=gid)
+        self._m_conn_reused = reg.counter(
+            "elephas_gateway_connections_reused_total",
+            "Requests served off an already-open keep-alive "
+            "connection (the handshake they did not pay)",
             labels=("gateway",),
         ).labels(gateway=gid)
         # anomaly watchdog (ISSUE 13): rules evaluate at /healthz
@@ -361,14 +414,113 @@ class Gateway:
         task = asyncio.current_task()
         self._tasks.add(task)
         self._writers.add(writer)
-        route, code = "other", 500
+        conn = _Conn()
+        try:
+            # keep-alive request loop (ISSUE 15 satellite): one
+            # connection may carry many requests; the first read sits
+            # under the full read deadline, subsequent ones under the
+            # bounded IDLE timeout (an open-but-silent keep-alive
+            # socket must not pin a handler task forever)
+            while await self._serve_one(reader, writer, conn):
+                conn.served += 1
+        except (ConnectionError, OSError) as e:
+            logger.info("gateway connection dropped (%r)", e)
+        except asyncio.CancelledError:
+            # stop() severing us — close fast, propagate nothing
+            pass  # fault-lint: allow — deliberate sever on stop()
+        except Exception:
+            logger.exception("gateway handler failed")
+        finally:
+            self._writers.discard(writer)
+            self._tasks.discard(task)
+            try:
+                writer.close()
+            except OSError:
+                pass  # fault-lint: allow — already-severed transport
+
+    async def _serve_one(self, reader, writer, conn: _Conn) -> bool:
+        """Read and answer ONE request off the connection. Returns
+        True when the connection persists for another request (client
+        asked for keep-alive, the response could honor it, and the
+        gateway is not stopping)."""
+        route, code = "other", None
+        first = conn.served == 0
         try:
             try:
-                # ONE deadline over the whole request read: the
-                # per-line timeouts inside cannot bound a client that
-                # dribbles a header every few seconds forever
-                method, path, body, headers = await asyncio.wait_for(
-                    self._read_request(reader), self.read_timeout
+                if first:
+                    # ONE deadline over the whole request read: the
+                    # per-line timeouts inside cannot bound a client
+                    # that dribbles a header every few seconds forever
+                    (method, path, body, headers,
+                     version) = await asyncio.wait_for(
+                        self._read_request(reader), self.read_timeout
+                    )
+                else:
+                    # the idle timeout governs only the WAIT for the
+                    # next request LINE; once bytes arrive the full
+                    # read deadline takes over (a large migrate body
+                    # on a reused connection must not race the short
+                    # idle clock)
+                    try:
+                        line = await asyncio.wait_for(
+                            reader.readline(),
+                            min(self.read_timeout,
+                                self.keepalive_idle_timeout),
+                        )
+                        # RFC 7230 §3.5: ignore blank line(s) before
+                        # the next request line (bounded — a blank
+                        # flood must not pin the handler)
+                        skipped = 0
+                        while line in (b"\r\n", b"\n") and skipped < 4:
+                            skipped += 1
+                            line = await asyncio.wait_for(
+                                reader.readline(),
+                                min(self.read_timeout,
+                                    self.keepalive_idle_timeout),
+                            )
+                    except asyncio.TimeoutError:
+                        return False  # idle expiry: just close
+                    if not line or line in (b"\r\n", b"\n"):
+                        return False  # clean close between requests
+                    # this request rode an already-open connection —
+                    # the handshake it did not pay (ISSUE 15)
+                    self._m_conn_reused.inc()
+                    (method, path, body, headers,
+                     version) = await asyncio.wait_for(
+                        self._read_request(reader, first_line=line),
+                        self.read_timeout,
+                    )
+            except _ConnectionClosed:
+                if first:
+                    code = 400
+                    await self._write(writer, _json_response(
+                        400, {"error": "empty request"}
+                    ))
+                return False
+            except _HttpError as e:
+                # a read-side refusal (malformed line, oversized or
+                # chunked body) still gets its response — and always
+                # closes: the connection's framing cannot be trusted
+                # past a failed read
+                code = e.code
+                await self._write(writer, _json_response(
+                    e.code, {"error": str(e)}, e.extra_headers
+                ))
+                return False
+            except asyncio.TimeoutError:
+                code = 408
+                await self._write(writer, _json_response(
+                    408, {"error": "request read timed out"}
+                ))
+                return False
+            try:
+                conn_hdr = headers.get("connection", "").lower()
+                conn.persist = (
+                    self.keepalive_idle_timeout > 0
+                    and "close" not in conn_hdr
+                    and (version == "HTTP/1.1"
+                         or "keep-alive" in conn_hdr)
+                    and not self._stopping.is_set()
                 )
                 route = self._route_label(method, path)
                 # gateway label + (for /v1/generate, set below) the
@@ -381,37 +533,29 @@ class Gateway:
                     gateway=self.telemetry_label,
                 ) as span:
                     code = await self._route(
-                        method, path, body, headers, writer, span
+                        method, path, body, headers, writer, span,
+                        conn,
                     )
             except _HttpError as e:
                 code = e.code
                 await self._write(writer, _json_response(
-                    e.code, {"error": str(e)}, e.extra_headers
+                    e.code, {"error": str(e)}, e.extra_headers,
+                    close=conn.close_header(),
                 ))
-            except asyncio.TimeoutError:
-                code = 408
-                await self._write(writer, _json_response(
-                    408, {"error": "request read timed out"}
-                ))
-        except (ConnectionError, OSError) as e:
-            logger.info("gateway connection dropped (%r)", e)
-        except asyncio.CancelledError:
-            # stop() severing us — close fast, propagate nothing
-            pass  # fault-lint: allow — deliberate sever on stop()
-        except Exception:
-            logger.exception("gateway handler failed")
-            code = 500
+            except Exception:
+                # an unexpected handler failure must still land in the
+                # request metric as a 500 before _handle logs it and
+                # severs the connection — a fleet watching the 5xx
+                # rate cannot be blind to crashing handlers
+                code = 500
+                raise
         finally:
-            self._m_requests.labels(
-                gateway=self.telemetry_label, route=route,
-                code=str(code),
-            ).inc()
-            self._writers.discard(writer)
-            self._tasks.discard(task)
-            try:
-                writer.close()
-            except OSError:
-                pass  # fault-lint: allow — already-severed transport
+            if code is not None:
+                self._m_requests.labels(
+                    gateway=self.telemetry_label, route=route,
+                    code=str(code),
+                ).inc()
+        return conn.persist
 
     _TRACE_PATH = re.compile(r"^/v1/requests/(\d+)/trace$")
     _CANCEL_PATH = re.compile(r"^/v1/requests/(\d+)/cancel$")
@@ -441,16 +585,20 @@ class Gateway:
             return route
         return "other"
 
-    async def _read_request(self, reader):
+    async def _read_request(self, reader, first_line=None):
         # no per-read deadlines here: the caller wraps this WHOLE
         # coroutine in one wait_for(read_timeout), which is the bound
         # that actually governs (per-line timeouts could never cut a
-        # client dribbling one header per interval loose)
-        line = await reader.readline()
+        # client dribbling one header per interval loose).
+        # ``first_line`` — a request line the keep-alive loop already
+        # read under the idle timeout.
+        line = first_line
+        if line is None:
+            line = await reader.readline()
         if not line:
-            raise _HttpError(400, "empty request")
+            raise _ConnectionClosed()
         try:
-            method, path, _version = line.decode("ascii").split()
+            method, path, version = line.decode("ascii").split()
         except ValueError:
             raise _HttpError(400, f"malformed request line {line!r}")
         headers = {}
@@ -466,23 +614,38 @@ class Gateway:
                     v.strip().decode("latin-1")
                 )
         body = b""
-        if method == "POST":
-            try:
-                n = int(headers.get("content-length", "0"))
-            except ValueError:
-                raise _HttpError(400, "bad Content-Length")
-            limit = (
-                self.max_migrate_body
-                if path.split("?", 1)[0] == "/v1/migrate"
-                else self.max_body
+        if "transfer-encoding" in headers:
+            # bodies arrive via Content-Length ONLY. Silently reading
+            # a 0-byte body under keep-alive would leave the chunked
+            # payload buffered on the socket and parse it as the NEXT
+            # request line — attacker-controlled request smuggling
+            # behind any validating front proxy. Refuse, and the
+            # caller closes (framing past this point is untrusted).
+            raise _HttpError(
+                501, "Transfer-Encoding is not supported — send a "
+                     "Content-Length body"
             )
-            if n > limit:
-                raise _HttpError(
-                    413, f"body of {n} bytes exceeds {limit}"
-                )
-            if n:
-                body = await reader.readexactly(n)
-        return method, path, body, headers
+        try:
+            n = int(headers.get("content-length", "0"))
+        except ValueError:
+            raise _HttpError(400, "bad Content-Length")
+        limit = (
+            self.max_migrate_body
+            if path.split("?", 1)[0] == "/v1/migrate"
+            else self.max_body
+        )
+        if n > limit:
+            raise _HttpError(
+                413, f"body of {n} bytes exceeds {limit}"
+            )
+        if n:
+            # consume the declared body for EVERY method: a GET with
+            # a Content-Length body left unread would desync the
+            # keep-alive framing — the body bytes would parse as the
+            # next request line (same smuggling class as the
+            # Transfer-Encoding refusal above)
+            body = await reader.readexactly(n)
+        return method, path, body, headers, version
 
     async def _write(self, writer, data: bytes) -> None:
         # sockets.py lesson: sendall/drain after every write — a slow
@@ -491,12 +654,14 @@ class Gateway:
         await writer.drain()
 
     async def _route(self, method, path, body, headers, writer,
-                     span=None) -> int:
+                     span=None, conn=None) -> int:
+        if conn is None:
+            conn = _Conn()
         path = path.split("?", 1)[0]
         if path == "/v1/generate":
             if method != "POST":
                 raise _HttpError(405, "POST only")
-            return await self._generate(body, writer, span)
+            return await self._generate(body, writer, span, conn)
         if path == "/metrics":
             if method != "GET":
                 raise _HttpError(405, "GET only")
@@ -510,46 +675,50 @@ class Gateway:
             else:
                 text = telemetry.render().encode("utf-8")
                 ctype = telemetry.CONTENT_TYPE
-            await self._write(writer, _response(200, text, ctype))
+            await self._write(writer, _response(
+                200, text, ctype, close=conn.close_header()
+            ))
             return 200
         if path == "/stats":
             if method != "GET":
                 raise _HttpError(405, "GET only")
             return await self._json_snapshot(
-                writer, lambda: self.engine.stats()
+                writer, lambda: self.engine.stats(), conn
             )
         if path == "/healthz":
             if method != "GET":
                 raise _HttpError(405, "GET only")
-            return await self._healthz(writer)
+            return await self._healthz(writer, conn)
         if path == "/debug/engine":
             if method != "GET":
                 raise _HttpError(405, "GET only")
             return await self._json_snapshot(
-                writer, lambda: self.engine.debug_snapshot()
+                writer, lambda: self.engine.debug_snapshot(), conn
             )
         m = self._TRACE_PATH.match(path)
         if m is not None:
             if method != "GET":
                 raise _HttpError(405, "GET only")
-            return await self._request_trace(int(m.group(1)), writer)
+            return await self._request_trace(
+                int(m.group(1)), writer, conn
+            )
         m = self._CANCEL_PATH.match(path)
         if m is not None:
             if method != "POST":
                 raise _HttpError(405, "POST only")
-            return await self._cancel(int(m.group(1)), writer)
+            return await self._cancel(int(m.group(1)), writer, conn)
         m = self._EXPORT_PATH.match(path)
         if m is not None:
             if method != "POST":
                 raise _HttpError(405, "POST only")
-            return await self._export(int(m.group(1)), writer)
+            return await self._export(int(m.group(1)), writer, conn)
         if path == "/v1/migrate":
             if method != "POST":
                 raise _HttpError(405, "POST only")
-            return await self._migrate(body, writer)
+            return await self._migrate(body, writer, conn)
         raise _HttpError(404, f"no route {path}")
 
-    async def _cancel(self, rid: int, writer) -> int:
+    async def _cancel(self, rid: int, writer, conn) -> int:
         """``POST /v1/requests/{rid}/cancel`` — abort one in-flight
         request and reclaim its slot/blocks (ISSUE 14). 404 when the
         rid is unknown or already finished (nothing to reclaim)."""
@@ -566,10 +735,11 @@ class Gateway:
         await self._write(writer, _json_response(
             200, {"rid": rid, "cancelled": True},
             extra_headers=(("X-Request-Id", str(rid)),),
+            close=conn.close_header(),
         ))
         return 200
 
-    async def _export(self, rid: int, writer) -> int:
+    async def _export(self, rid: int, writer, conn) -> int:
         """``POST /v1/requests/{rid}/export`` — freeze one live
         request and return its migration record as the v1 binary wire
         format (ISSUE 14): the request LEAVES this engine; POST the
@@ -602,10 +772,11 @@ class Gateway:
         await self._write(writer, _response(
             200, payload, "application/octet-stream",
             extra_headers=(("X-Request-Id", str(rid)),),
+            close=conn.close_header(),
         ))
         return 200
 
-    async def _migrate(self, body: bytes, writer) -> int:
+    async def _migrate(self, body: bytes, writer, conn) -> int:
         """``POST /v1/migrate`` — adopt a migration record exported by
         another replica (the drain/rebalance wire, ISSUE 14). The body
         is the v1 binary record; the response confirms the adopted rid
@@ -631,10 +802,11 @@ class Gateway:
         await self._write(writer, _json_response(
             200, {"rid": rid, "warm": warm},
             extra_headers=(("X-Request-Id", str(rid)),),
+            close=conn.close_header(),
         ))
         return 200
 
-    async def _json_snapshot(self, writer, fn) -> int:
+    async def _json_snapshot(self, writer, fn, conn) -> int:
         """Serve ``fn()`` (engine introspection under the engine lock)
         as one JSON document, computed off-loop: the lock may be held
         by a long engine step and must not freeze the event loop."""
@@ -648,11 +820,12 @@ class Gateway:
 
         body = await loop.run_in_executor(None, snapshot)
         await self._write(writer, _response(
-            200, body, "application/json"
+            200, body, "application/json",
+            close=conn.close_header(),
         ))
         return 200
 
-    async def _request_trace(self, rid: int, writer) -> int:
+    async def _request_trace(self, rid: int, writer, conn) -> int:
         """``GET /v1/requests/{rid}/trace`` — the engine's flight-
         recorder record for one request (ISSUE 12). 404 for an
         unknown/evicted rid, 501 when the recorder is off (retrying
@@ -671,11 +844,13 @@ class Gateway:
         except RuntimeError as e:
             raise _HttpError(501, str(e))
         await self._write(writer, _json_response(
-            200, record, extra_headers=(("X-Request-Id", str(rid)),)
+            200, record,
+            extra_headers=(("X-Request-Id", str(rid)),),
+            close=conn.close_header(),
         ))
         return 200
 
-    async def _healthz(self, writer) -> int:
+    async def _healthz(self, writer, conn) -> int:
         """Cheap liveness for the fleet router (ISSUE 12 satellite):
         200 when the engine driver thread is alive, the gateway is not
         stopping, and — when there is work — steps are advancing;
@@ -730,7 +905,8 @@ class Gateway:
                 "active": report["active"],
             }
         await self._write(writer, _json_response(
-            200 if status == "ok" else 503, body
+            200 if status == "ok" else 503, body,
+            close=conn.close_header(),
         ))
         return 200 if status == "ok" else 503
 
@@ -742,19 +918,53 @@ class Gateway:
         if not isinstance(spec, dict):
             raise _HttpError(400, "body must be a JSON object")
         unknown = set(spec) - {
-            "prompt", "max_new_tokens", "temperature", "eos_id",
-            "tenant", "ttft_deadline_ms", "priority", "stream",
+            "prompt", "prompts", "max_new_tokens", "temperature",
+            "eos_id", "tenant", "ttft_deadline_ms", "priority",
+            "stream",
         }
         if unknown:
             raise _HttpError(400, f"unknown fields {sorted(unknown)}")
-        if "prompt" not in spec or "max_new_tokens" not in spec:
+        if ("prompt" in spec) == ("prompts" in spec):
+            raise _HttpError(
+                400, "exactly one of prompt / prompts is required"
+            )
+        if "max_new_tokens" not in spec:
             raise _HttpError(
                 400, "prompt and max_new_tokens are required"
             )
+        if "prompts" in spec:
+            prompts = spec["prompts"]
+            if not isinstance(prompts, list) or not prompts or not all(
+                isinstance(p, list) for p in prompts
+            ):
+                raise _HttpError(
+                    400, "prompts must be a non-empty list of "
+                         "token lists"
+                )
+            if len(prompts) > self.max_batch_prompts:
+                raise _HttpError(
+                    413,
+                    f"{len(prompts)} prompts exceed the batch bound "
+                    f"{self.max_batch_prompts} — split the POST",
+                )
         return spec
 
-    async def _generate(self, body, writer, span=None) -> int:
+    def _submit_kwargs(self, spec) -> dict:
+        return dict(
+            temperature=float(spec.get("temperature", 0.0)),
+            eos_id=spec.get("eos_id"),
+            tenant=spec.get("tenant"),
+            ttft_deadline_ms=spec.get("ttft_deadline_ms"),
+            priority=int(spec.get("priority", 0)),
+        )
+
+    async def _generate(self, body, writer, span=None,
+                        conn=None) -> int:
+        if conn is None:
+            conn = _Conn()
         spec = self._parse_generate(body)
+        if "prompts" in spec:
+            return await self._generate_batch(spec, writer, span, conn)
         stream = bool(spec.pop("stream", True))
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
@@ -777,12 +987,7 @@ class Gateway:
                     raise _HttpError(503, "gateway is stopping")
                 return self.engine.submit(
                     spec["prompt"], spec["max_new_tokens"],
-                    temperature=float(spec.get("temperature", 0.0)),
-                    eos_id=spec.get("eos_id"),
-                    tenant=spec.get("tenant"),
-                    ttft_deadline_ms=spec.get("ttft_deadline_ms"),
-                    priority=int(spec.get("priority", 0)),
-                    on_token=on_token,
+                    on_token=on_token, **self._submit_kwargs(spec),
                 )
 
         try:
@@ -810,8 +1015,185 @@ class Gateway:
             raise _HttpError(422, str(req.error), extra_headers=(rid_hdr,))
         self._work.set()  # wake the driver
         if stream:
+            conn.persist = False  # the SSE stream owns this socket
             return await self._stream_sse(req, q, writer)
-        return await self._respond_once(req, q, writer)
+        return await self._respond_once(req, q, writer, conn)
+
+    async def _generate_batch(self, spec, writer, span, conn) -> int:
+        """The ``prompts`` batch form (ISSUE 15 satellite — ROADMAP
+        item 2): one POST carries N prompts, amortizing the handshake
+        and request parse. Each prompt is a NORMAL ``submit()`` —
+        admission control, policy accounting, and the paged never-fit
+        rejection see them individually, so one shed prompt comes
+        back as ITS entry's error while the rest serve.
+
+        ``stream: false`` answers one JSON document with a
+        ``results`` array (index-aligned with ``prompts``);
+        ``stream: true`` multiplexes every request onto ONE SSE
+        stream: an opening ``data: {"rids": [...]}`` event, then
+        ``data: {"rid": r, "token": t, "done": d}`` per token in
+        arrival order, then an ``event: done`` summary."""
+        prompts = spec.pop("prompts")
+        stream = bool(spec.pop("stream", True))
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        try:
+            # batch-WIDE fields (shared by every prompt) fail the
+            # whole request as a clean 400, exactly like the
+            # single-prompt form's do_submit mapping — an uncaught
+            # float("hot") here would sever the connection with no
+            # response at all
+            kwargs = self._submit_kwargs(spec)
+        except (ValueError, TypeError) as e:
+            raise _HttpError(400, str(e))
+        max_new = spec["max_new_tokens"]
+
+        def make_cb(i):
+            def on_token(token, done):
+                loop.call_soon_threadsafe(
+                    q.put_nowait,
+                    (i, None if token is None else int(token),
+                     bool(done)),
+                )
+
+            return on_token
+
+        def do_submit():
+            out = []
+            with self._engine_lock:
+                if self._stopping.is_set():
+                    raise _HttpError(503, "gateway is stopping")
+                for i, p in enumerate(prompts):
+                    try:
+                        r = self.engine.submit(
+                            p, max_new, on_token=make_cb(i), **kwargs
+                        )
+                    except (ValueError, TypeError) as e:
+                        out.append((e, True))
+                    else:
+                        # classify HERE, under the engine lock: done
+                        # at this instant can only mean a submit-time
+                        # reject (shed / never-fit — it never feeds
+                        # its queue). Snapshotting done AFTER the lock
+                        # releases raced the driver thread: a 1-token
+                        # request it finished in between looked like a
+                        # reject and its queued tokens were never
+                        # drained.
+                        out.append((r, r.done))
+            return out
+
+        submitted = await loop.run_in_executor(None, do_submit)
+        if span is not None:
+            span.set(batch=len(prompts))
+        entries = []
+        pending: set[int] = set()
+        for i, (r, rejected) in enumerate(submitted):
+            if isinstance(r, BaseException):
+                entries.append({
+                    "index": i, "rid": None, "tokens": [],
+                    "error": str(r),
+                })
+            else:
+                entries.append({
+                    "index": i, "rid": r.rid, "tokens": [],
+                    "error": (
+                        None if r.error is None else str(r.error)
+                    ),
+                })
+                if not rejected:
+                    pending.add(i)
+        submitted = [r for r, _rejected in submitted]
+        self._work.set()
+        if stream:
+            conn.persist = False
+            return await self._stream_batch_sse(
+                entries, pending, submitted, q, writer
+            )
+        while pending:
+            i, token, done = await q.get()
+            if token is not None:
+                entries[i]["tokens"].append(token)
+            if done:
+                pending.discard(i)
+                r = submitted[i]
+                entries[i]["error"] = (
+                    None if r.error is None else str(r.error)
+                )
+        for i, r in enumerate(submitted):
+            if not isinstance(r, BaseException):
+                entries[i]["full_sequence"] = (
+                    list(r.prompt) + list(r.tokens)
+                )
+        await self._write(writer, _json_response(
+            200, {"results": entries}, close=conn.close_header(),
+        ))
+        return 200
+
+    async def _stream_batch_sse(self, entries, pending, submitted, q,
+                                writer) -> int:
+        head = (
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        self._m_sse_active.inc()
+        try:
+            await self._write(writer, head)
+            await self._write(writer, _sse_event({
+                "rids": [e["rid"] for e in entries],
+                "errors": {
+                    str(e["index"]): e["error"]
+                    for e in entries if e["error"] is not None
+                },
+            }))
+            while pending:
+                i, token, done = await q.get()
+                rid = entries[i]["rid"]
+                if token is not None:
+                    await self._write(writer, _sse_event(
+                        {"rid": rid, "token": token, "done": done}
+                    ))
+                if done:
+                    pending.discard(i)
+            final = {
+                "rids": [e["rid"] for e in entries],
+                "n_tokens": {
+                    str(e["rid"]): len(submitted[e["index"]].tokens)
+                    for e in entries if e["rid"] is not None
+                },
+                "errors": {
+                    str(e["rid"]):
+                        None if submitted[e["index"]].error is None
+                        else str(submitted[e["index"]].error)
+                    for e in entries if e["rid"] is not None
+                },
+            }
+            await self._write(writer, _sse_event(final, event="done"))
+        except (ConnectionError, OSError) as e:
+            # client went away mid-stream: cancel every still-live
+            # request of the batch (the single-stream disconnect rule,
+            # batch-wide)
+            logger.info(
+                "batch SSE client disconnected mid-stream (%r) — "
+                "cancelling %d live requests", e, len(pending),
+            )
+            if not self._stopping.is_set() and pending:
+                loop = asyncio.get_running_loop()
+                rids = [
+                    entries[i]["rid"] for i in pending
+                    if entries[i]["rid"] is not None
+                ]
+
+                def do_cancel():
+                    with self._engine_lock:
+                        for rid in rids:
+                            self.engine.cancel(rid)
+
+                await loop.run_in_executor(None, do_cancel)
+        finally:
+            self._m_sse_active.dec()
+        return 200
 
     async def _drain_tokens(self, req, q) -> list:
         tokens = []
@@ -822,7 +1204,7 @@ class Gateway:
             if done:
                 return tokens
 
-    async def _respond_once(self, req, q, writer) -> int:
+    async def _respond_once(self, req, q, writer, conn=None) -> int:
         tokens = await self._drain_tokens(req, q)
         payload = {
             "rid": req.rid,
@@ -833,6 +1215,7 @@ class Gateway:
         await self._write(writer, _json_response(
             200, payload,
             extra_headers=(("X-Request-Id", str(req.rid)),),
+            close=True if conn is None else conn.close_header(),
         ))
         return 200
 

@@ -43,6 +43,13 @@ masked off by position visibility). Padding with 0 would alias block 0.
 preempt/resume: gather reads a victim's blocks into dense rows for
 host offload (``jax.device_get``), scatter writes them back into a
 fresh allocation bit-exactly. One compile per table bucket each.
+
+The sentinel/table conventions here (pad with ``num_blocks``, route
+cursor overrun to the sentinel, ``table_buckets`` ladder) are shared
+verbatim by the pipeline-parallel engine's per-stage pools
+(:mod:`elephas_tpu.serving.pp_engine`, ISSUE 15) — its stage-local
+attention closures mirror this module's ``local=True`` fast path
+inside ``shard_map``, where native gather/scatter is always legal.
 """
 
 from __future__ import annotations

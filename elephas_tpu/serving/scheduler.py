@@ -199,9 +199,27 @@ class Scheduler:
 
     def __init__(self, num_slots: int, buckets, prefix_cache: bool = False,
                  prefix_min_reuse: int = 1, allocator=None,
-                 preemption: bool = False, policy=None):
+                 preemption: bool = False, policy=None,
+                 wave_slots: int | None = None):
         self.num_slots = int(num_slots)
         self.buckets = tuple(sorted(int(b) for b in buckets))
+        # wave-aware admission (ISSUE 15): the PP engine partitions
+        # the arena statically into waves of `wave_slots` slots (slot
+        # i -> wave i // wave_slots); admission then picks the free
+        # slot whose wave holds the FEWEST active requests (ties: the
+        # lowest wave, then the lowest slot — fully deterministic, so
+        # the gang contract is untouched). An unevenly-filled wave is
+        # a pipeline tick doing less work while another wave's slots
+        # queue, so balance is throughput, not taste. None keeps the
+        # legacy lowest-free-slot order byte-for-byte.
+        if wave_slots is not None:
+            wave_slots = int(wave_slots)
+            if wave_slots < 1 or self.num_slots % wave_slots:
+                raise ValueError(
+                    f"wave_slots={wave_slots} must be a positive "
+                    f"divisor of num_slots={self.num_slots}"
+                )
+        self.wave_slots = wave_slots
         self.waiting: deque[Request] = deque()
         self.active: dict[int, Request] = {}
         self._free: list[int] = list(range(self.num_slots))
@@ -393,6 +411,20 @@ class Scheduler:
         if self.policy is not None:
             self.policy.reorder(self.waiting, self._preempted)
 
+    def _pop_free_slot(self) -> int:
+        """Take one slot off the free list: lowest-first by default;
+        wave-aware under ``wave_slots`` (see ``__init__``) — the free
+        slot in the least-loaded wave, ties to the lowest slot."""
+        if self.wave_slots is None:
+            return self._free.pop(0)
+        ws = self.wave_slots
+        load = [0] * (self.num_slots // ws)
+        for slot in self.active:
+            load[slot // ws] += 1
+        best = min(self._free, key=lambda s: (load[s // ws], s))
+        self._free.remove(best)
+        return best
+
     def _dequeue_head(self) -> Request:
         """Pop the queue head into an admission: debt drops and the
         policy charges the prefill (a resume re-admission charges
@@ -445,7 +477,7 @@ class Scheduler:
                     cache.pin(donor)
                     pinned.append(donor)
             if self._free:
-                slot = self._free.pop(0)
+                slot = self._pop_free_slot()
             else:
                 slot = cache.evict_lru() if cache is not None else None
                 if slot is None and donor is not None:
@@ -560,7 +592,7 @@ class Scheduler:
                 idx.record_miss()
             own = alloc.alloc(own_need)
             assert own is not None  # guaranteed by the short check
-            slot = self._free.pop(0)
+            slot = self._pop_free_slot()
             self.tables[slot] = shared + own
             self.tables_version += 1
             req.slot = slot

@@ -317,6 +317,19 @@ class TestFaultPathLint:
             assert any(
                 f.endswith(os.path.join("fleet", mod)) for f in files
             ), mod
+        # ISSUE 15: the PP serving engine offloads/restores per-stage
+        # K/V across a ring — an eaten error mid-offload is a silently
+        # corrupted resume; pinned by name, and the serving-shaped
+        # stage planner rides along (a mis-planned split serves wrong
+        # depth silently)
+        assert any(
+            f.endswith(os.path.join("serving", "pp_engine.py"))
+            for f in files
+        )
+        files.append(os.path.join(
+            root, "elephas_tpu", "parallel", "pipeline_runner.py"
+        ))
+        assert os.path.exists(files[-1])
         return root, files
 
     def test_no_bare_or_swallowed_excepts_on_fault_paths(self):
@@ -495,6 +508,17 @@ class TestTelemetryWallClockLint:
         files.append(os.path.join(
             root, "elephas_tpu", "ops", "flash_serving.py"
         ))
+        # ISSUE 15: the PP wave schedule and the serving stage planner
+        # are pure functions of the submission sequence — wall clock
+        # in either would fork the waves gang processes must derive
+        # identically; pinned by name like the other serving modules
+        files.append(os.path.join(
+            root, "elephas_tpu", "parallel", "pipeline_runner.py"
+        ))
+        assert any(
+            f.endswith(os.path.join("serving", "pp_engine.py"))
+            for f in files
+        )
         assert len(files) > 9
         assert all(os.path.exists(f) for f in files), [
             f for f in files if not os.path.exists(f)
@@ -610,6 +634,14 @@ class TestTelemetryWallClockLint:
         )))
         assert any(
             f.endswith(os.path.join("fleet", "router.py"))
+            for f in files
+        )
+        # ISSUE 15: the PP engine's per-window telemetry (bubble
+        # gauge, serve.wave spans, jit.compile watching) records
+        # through attributes captured in __init__ like every other
+        # serving module; pinned by name so a rename cannot drop it
+        assert any(
+            f.endswith(os.path.join("serving", "pp_engine.py"))
             for f in files
         )
         offences = []

@@ -1175,6 +1175,81 @@ SERVE_SCRIPT = textwrap.dedent(
 )
 
 
+PP_SERVE_SCRIPT = textwrap.dedent(
+    """
+    import json, hashlib
+    from elephas_tpu.parallel import distributed
+
+    assert distributed.initialize(), "gang init failed"
+    import jax
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    import numpy as np
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate, transformer_lm
+    from elephas_tpu.serving import PPEngine
+
+    maxlen, vocab, n = 16, 8, 256
+    rng = np.random.default_rng(0)
+    starts = rng.integers(2, 6, size=n)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+
+    m = transformer_lm(vocab_size=vocab, maxlen=maxlen, d_model=32,
+                       num_heads=4, num_layers=2, dropout=0.0, lr=1e-2,
+                       seed=0)
+    SparkModel(m, num_workers=8).fit((x, y), epochs=3, batch_size=32)
+
+    # PP x TP SPANNING the gang: pipeline_mesh(2, model_parallel=4)
+    # puts stage 0 entirely on process 0's devices and stage 1 on
+    # process 1's, so EVERY ring tick's ppermute crosses the process
+    # boundary; both processes drive the identical submission schedule
+    # (the SPMD contract) and must read identical tokens
+    engine = PPEngine(m, num_stages=2, wave_slots=2, model_parallel=4,
+                      block_size=8, steps_per_wave=2)
+    prompts = [[2, 3, 4, 5], [4, 5], [3, 4, 5, 2, 3]]
+    reqs = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    served = engine.run()
+    ok = all(
+        bool((served[r.rid] ==
+              generate(m, np.asarray(p, np.int32)[None], steps=6,
+                       kv_cache=True)[0]).all())
+        for r, p in zip(reqs, prompts)
+    )
+    cs = engine.compile_stats()
+    print("PPSERVE " + json.dumps({
+        "process": jax.process_index(),
+        "match": ok,
+        "ring_decode_compiles": cs["ring_decode_compiles"],
+        "digest": hashlib.sha256(b"".join(
+            np.ascontiguousarray(served[r.rid]).tobytes() for r in reqs
+        )).hexdigest(),
+    }), flush=True)
+    """
+)
+
+
+def test_two_process_pp_serving_engine(tmp_path):
+    """ISSUE 15 (PP serving tentpole): the microbatched-wave PP×TP
+    engine runs across a 2-process gang — depth stages on devices the
+    other process cannot address, every decode tick's ppermute crossing
+    the process boundary — and both processes read tokens identical to
+    the single-device one-shot reference, from ONE ring-decode
+    compile."""
+    rc, output = _run_gang(str(tmp_path), PP_SERVE_SCRIPT)
+    assert rc == 0, output[-3000:]
+    results = [
+        json.loads(line.split("PPSERVE ", 1)[1])
+        for line in output.splitlines()
+        if "PPSERVE " in line
+    ]
+    assert len(results) == 2, output[-3000:]
+    a, b = sorted(results, key=lambda r: r["process"])
+    assert a["match"] and b["match"], (a, b)
+    assert a["digest"] == b["digest"], (a, b)
+    assert a["ring_decode_compiles"] == 1, a
+
+
 def test_two_process_serving_engine(tmp_path):
     """ISSUE 1 (serving tentpole): the continuous-batching engine runs
     across a 2-process gang on the TP mesh — slot arena data-sharded

@@ -1,0 +1,371 @@
+"""Pipeline-parallel serving (ISSUE 15): continuous batching across a
+PP(×TP) mesh with microbatched decode waves.
+
+Contracts pinned here:
+
+- temp-0 token-exactness vs the unmeshed one-shot ``generate()`` on
+  the PP mesh AND the PP×TP mesh (the 2-process gang variant lives in
+  ``test_multihost.py`` with the other gang tests);
+- a CLOSED compile set — a second identical workload adds nothing;
+- per-stage pool reclamation and preempt → per-stage offload → resume
+  bit-exactness;
+- mid-flight arrival into a running wave;
+- wave-aware admission keeps the waves balanced;
+- report-only PP telemetry (bubble-fraction gauge, per-wave occupancy,
+  ``serve.wave`` spans) rides along without driving anything.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def lm(serving_lm):
+    """The session-trained serving LM (see conftest.serving_lm)."""
+    return serving_lm
+
+
+def _ref(model, prompt, steps):
+    from elephas_tpu.models.transformer import generate
+
+    return generate(
+        model, np.asarray(prompt, np.int32)[None], steps=steps,
+        kv_cache=True,
+    )[0]
+
+
+def _assert_exact(model, reqs):
+    for req in reqs:
+        ref = _ref(model, req.prompt, req.max_new_tokens)
+        np.testing.assert_array_equal(
+            np.asarray(req.full_sequence, np.int32), ref,
+            err_msg=f"rid {req.rid} diverged from one-shot",
+        )
+
+
+# -- stage planner -----------------------------------------------------
+
+
+def test_plan_serving_stages_balances_attention_layers(lm):
+    from elephas_tpu.parallel.pipeline_runner import plan_serving_stages
+
+    plan = plan_serving_stages(lm, 2)
+    assert plan.num_stages == 2
+    assert [len(f) for f in plan.flash] == [1, 1]
+    names = plan.stage_summary()
+    # embedding enters with the first stage, the head leaves with the
+    # last — no device ever holds the full depth
+    assert any("tok_embed" in n for n in names[0])
+    assert any("lm_head" in n for n in names[1])
+    assert all(d == 32 for d in plan.boundary_dims)
+
+
+def test_plan_serving_stages_refuses_uneven_split(lm):
+    from elephas_tpu.parallel.pipeline_runner import plan_serving_stages
+
+    with pytest.raises(ValueError, match="do not split evenly"):
+        plan_serving_stages(lm, 3)  # 2 attention layers over 3 stages
+
+
+# -- temp-0 token parity ------------------------------------------------
+
+
+def test_pp_decode_token_exact_vs_oneshot(lm):
+    """Mixed prompt lengths, EOS and budget finishes, several waves:
+    every stream must equal the unmeshed one-shot greedy tokens."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=2, block_size=8,
+        steps_per_wave=2,
+    )
+    specs = [
+        ([2, 3, 4], 8), ([5, 4], 6), ([3, 3, 4, 5], 5),
+        ([2, 5, 3], 9), ([4, 5, 2, 3, 4], 4), ([3, 2], 7),
+    ]
+    reqs = [engine.submit(p, mn) for p, mn in specs]
+    out = engine.run()
+    assert set(out) == {r.rid for r in reqs}
+    _assert_exact(lm, reqs)
+    st = engine.stats()
+    assert st["finished"] == len(specs)
+    assert st["blocks_free"] == st["blocks_total"]  # full reclamation
+
+
+def test_pp_tp_decode_token_exact(lm):
+    """PP×TP: 2 stages × 2 model ranks — heads split inside each
+    stage, depth over the ring — still greedy-exact vs unmeshed
+    one-shot."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=2, model_parallel=2,
+        block_size=8, steps_per_wave=2,
+    )
+    specs = [([2, 3, 4], 8), ([5, 4], 6), ([3, 3, 4, 5], 5)]
+    reqs = [engine.submit(p, mn) for p, mn in specs]
+    engine.run()
+    _assert_exact(lm, reqs)
+
+
+def test_pp_naive_attention_is_the_parity_oracle(lm):
+    """attention='naive' (the full-materialized oracle) produces the
+    same greedy tokens as the default flash path."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=1, block_size=8,
+        steps_per_wave=2, attention="naive",
+    )
+    reqs = [engine.submit([2, 3, 4], 6), engine.submit([5, 4], 5)]
+    engine.run()
+    _assert_exact(lm, reqs)
+    assert engine.compile_stats()["attention"] == "naive"
+
+
+def test_pp_eos_finish(lm):
+    from elephas_tpu.serving import PPEngine
+
+    prompt = [2, 3, 4]
+    ref = _ref(lm, prompt, 10)
+    eos = int(ref[len(prompt) + 2])  # force an early EOS finish
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=1, block_size=8,
+        steps_per_wave=4,
+    )
+    req = engine.submit(prompt, 10, eos_id=eos)
+    engine.run()
+    assert req.tokens[-1] == eos
+    assert len(req.tokens) <= 10
+    np.testing.assert_array_equal(
+        req.full_sequence, ref[: len(prompt) + len(req.tokens)]
+    )
+
+
+# -- mid-flight arrival -------------------------------------------------
+
+
+def test_pp_mid_flight_arrival_into_running_wave(lm):
+    """A request submitted while waves are decoding joins the next
+    window boundary and stays token-exact — as does everything already
+    in flight."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=2, block_size=8,
+        steps_per_wave=2,
+    )
+    first = [engine.submit([2, 3, 4], 8), engine.submit([5, 4], 8)]
+    engine.step()  # admit + first decode window
+    engine.step()
+    late = engine.submit([3, 4, 5, 2], 6)
+    assert late.submit_step > 0  # arrived into a RUNNING schedule
+    while engine.scheduler.has_work:
+        engine.step()
+    _assert_exact(lm, first + [late])
+
+
+# -- closed compile set -------------------------------------------------
+
+
+def test_pp_closed_compile_set(lm):
+    """A second identical workload compiles NOTHING: ring decode per
+    table bucket, ring prefill per (width, table bucket), all closed
+    ladders."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=2, block_size=8,
+        steps_per_wave=2,
+    )
+    specs = [
+        ([2, 3, 4], 6), ([5, 4], 5), ([3, 3, 4, 5], 4), ([2, 5], 6),
+    ]
+    engine.run(list(specs))
+    first = engine.compile_stats()
+    engine.run(list(specs))
+    assert engine.compile_stats() == first
+    assert first["ring_decode_compiles"] <= len(first["table_buckets"])
+
+
+# -- per-stage pools: reclamation + preempt/resume ----------------------
+
+
+def test_pp_preempt_offload_resume_token_exact(lm):
+    """Pool pressure preempts the low-priority victim (per-stage
+    offload gathers), the arrival admits, the victim resumes
+    bit-exact — and every stage's pool fully reclaims at drain."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=1, block_size=8, num_blocks=3,
+        steps_per_wave=1, preemption=True,
+    )
+    low = engine.submit([2, 3, 4], 12, priority=0)
+    for _ in range(3):
+        engine.step()
+    high = engine.submit([5, 4, 3], 8, priority=1)
+    while engine.scheduler.has_work:
+        engine.step()
+    st = engine.stats()
+    assert st["preemptions"] >= 1 and st["resumes"] >= 1
+    # offloaded_blocks counts per-stage rows: blocks * num_stages
+    assert st["offloaded_blocks"] >= engine.num_stages
+    assert st["offloaded_blocks"] % engine.num_stages == 0
+    _assert_exact(lm, [low, high])
+    assert st["blocks_free"] == st["blocks_total"]
+    assert not engine._offloaded
+    assert not engine.scheduler.tables
+
+
+def test_pp_equal_priority_never_preempts(lm):
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=1, block_size=8, num_blocks=3,
+        steps_per_wave=1, preemption=True,
+    )
+    first = engine.submit([2, 3, 4], 12, priority=0)
+    for _ in range(3):
+        engine.step()
+    second = engine.submit([5, 4, 3], 8, priority=0)
+    while engine.scheduler.has_work:
+        engine.step()
+    assert engine.stats()["preemptions"] == 0
+    _assert_exact(lm, [first, second])
+
+
+# -- wave-aware admission ----------------------------------------------
+
+
+def test_wave_aware_admission_balances_waves(lm):
+    """Two admissions on an empty 2-wave engine land in DIFFERENT
+    waves (one slot each), so both pipeline waves carry work instead
+    of one wave queueing behind the other."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=2, block_size=8,
+        steps_per_wave=1,
+    )
+    a = engine.submit([2, 3], 4)
+    b = engine.submit([4, 5], 4)
+    engine.step()
+    ws = engine.wave_slots
+    assert a.slot // ws != b.slot // ws
+    engine.run()
+    _assert_exact(lm, [a, b])
+
+
+def test_scheduler_wave_slots_validation():
+    from elephas_tpu.serving import Scheduler, default_buckets
+
+    with pytest.raises(ValueError, match="divisor"):
+        Scheduler(4, default_buckets(16), wave_slots=3)
+
+
+# -- telemetry: observes, never drives ---------------------------------
+
+
+def test_pp_bubble_gauge_and_wave_span(lm):
+    from elephas_tpu import telemetry
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=2, block_size=8,
+        steps_per_wave=2,
+    )
+    engine.run([([2, 3, 4], 6), ([5, 4], 6)])
+    st = engine.stats()
+    # S=2, k=2: schedule is S·k + S − 1 = 5 ticks over 2 stages; with
+    # both waves live the ramp/drain bubble is 1 − (2·2·2)/(2·5) = 0.2
+    assert 0.0 < st["bubble_fraction"] < 1.0
+    text = engine.scrape(full=False)
+    assert "elephas_pp_bubble_fraction" in text
+    assert 'elephas_pp_wave_active_slots{' in text
+    events = telemetry.tracer().events()
+    waves = [e for e in events if e.get("name") == "serve.wave"]
+    assert waves
+    assert all("bubble" in e["args"] for e in waves)
+
+
+# -- knob validation + graceful rejection -------------------------------
+
+
+def test_pp_knob_validation(lm):
+    from elephas_tpu.serving import PPEngine
+
+    with pytest.raises(ValueError, match="num_heads"):
+        PPEngine(lm, num_stages=2, model_parallel=4)  # 2 heads
+    with pytest.raises(ValueError, match="wave_slots"):
+        PPEngine(lm, num_stages=2, wave_slots=0)
+    with pytest.raises(ValueError, match="steps_per_wave"):
+        PPEngine(lm, num_stages=2, steps_per_wave=0)
+    with pytest.raises(ValueError, match="attention"):
+        PPEngine(lm, num_stages=2, attention="fused")
+    with pytest.raises(ValueError, match="block_size"):
+        PPEngine(lm, num_stages=2, block_size=999)
+    with pytest.raises(ValueError, match=">= 2 stages"):
+        PPEngine(lm, num_stages=1)
+
+
+def test_pp_unfit_submit_rejected_gracefully(lm, caplog):
+    """A request that can NEVER fit the per-stage pool is rejected at
+    submit (error + done, never queued) and the engine keeps
+    serving."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=1, block_size=8, num_blocks=2,
+        steps_per_wave=1,
+    )
+    with caplog.at_level(
+        logging.WARNING, "elephas_tpu.serving.pp_engine"
+    ):
+        bad = engine.submit([2, 3, 4, 5, 2, 3, 4, 5, 2], 20)
+    assert bad.done and isinstance(bad.error, RuntimeError)
+    assert "never" in str(bad.error)
+    assert engine.stats()["rejected"] == 1
+    ok = engine.submit([2, 3], 4)
+    engine.run()
+    _assert_exact(lm, [ok])
+
+
+def test_pp_priority_warns_without_preemption(lm, caplog):
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=1, block_size=8,
+        steps_per_wave=1,
+    )
+    with caplog.at_level(
+        logging.WARNING, "elephas_tpu.serving.pp_engine"
+    ):
+        engine.submit([2, 3], 2, priority=5)
+    assert any("IGNORED" in r.message for r in caplog.records)
+    engine.run()
+
+
+def test_pp_refresh_weights_reuploads(lm):
+    """refresh_weights() re-stages the stacked flat buffer — new
+    requests decode under the new weights with no recompile."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=1, block_size=8,
+        steps_per_wave=2,
+    )
+    engine.run([([2, 3, 4], 4)])
+    before = engine.compile_stats()
+    orig = lm.get_weights()
+    try:
+        lm.set_weights([w * 1.01 for w in orig])
+        engine.refresh_weights()
+        req = engine.submit([2, 3, 4], 4)
+        engine.run()
+        _assert_exact(lm, [req])  # reference under the NEW weights
+        assert engine.compile_stats() == before
+    finally:
+        lm.set_weights(orig)

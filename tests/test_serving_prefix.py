@@ -564,7 +564,18 @@ def test_serving_bench_smoke_prefix_and_interference():
     rec = json.loads(lines[0])
     assert {"metric", "value", "vs_baseline", "prefix",
             "interference"} <= set(rec)
-    assert rec["decode_compiles"] == 1
+    # ROOT-CAUSED (ISSUE 15 satellite): since PR 10 the headline
+    # engine defaults to attention="flash", whose fixed-arena decode
+    # compiles one program per touched SPAN BUCKET — this workload's
+    # residents (40-token prompt + 32 budget = 72) cross the 64
+    # bucket of the (64, 128) ladder, so TWO decode compiles are the
+    # correct, deterministic outcome, not churn. The seed-era "== 1"
+    # encoded the pre-flash single-program contract; the real
+    # invariant — warmup covers every touched shape and the timed
+    # rounds compile NOTHING — is now gated inside measure_serving
+    # itself (the bench refuses JSON on a timed-round compile), so
+    # this line receiving a record at all proves it held.
+    assert 1 <= rec["decode_compiles"] <= len(rec["span_buckets"]), rec
     assert rec["ttft_p50_ms"] > 0 and rec["itl_p99_ms"] > 0
     pre = rec["prefix"]
     assert pre["ttft_ms_off"] > 0 and pre["ttft_ms_hit"] > 0
