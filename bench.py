@@ -63,6 +63,20 @@ class ImplausibleTiming(RuntimeError):
     """A timed window that physics rules out (see BENCH_r03.json)."""
 
 
+def emit_json(out: dict) -> None:
+    """Print the artifact JSON with the backend-fallback record attached.
+
+    Every preset routes its final artifact through here so a run that
+    silently fell back to CPU (the BENCH_r05 ``make_c_api_client``
+    plugin-init crash) is distinguishable from a healthy accelerator
+    run: ``backend_fallback`` is null when discovery came up on the
+    wanted platform, else ``{"wanted", "got", "reason"}``."""
+    from elephas_tpu.utils.backend_guard import last_fallback
+
+    out["backend_fallback"] = last_fallback()
+    print(json.dumps(out))
+
+
 class DivergedRun(RuntimeError):
     """The measured training itself diverged (NaN loss) — a MODEL
     problem, not a timing-instrument problem; retrying the measurement
@@ -2900,6 +2914,204 @@ def measure_fleet(n_requests: int, num_slots: int, seed: int = 0):
     }
 
 
+def _pp_bubblefill_section(model, generate, rounds: int = 5):
+    """The ``--preset pp`` ``bubblefill`` section (ISSUE 16): mid-flight
+    long-prompt TTFT with bubble-filling chunked prefill vs the
+    between-window (standalone prefill ring) arm, during saturated
+    decode.
+
+    Geometry is picked so the comparison is STRUCTURAL, not a race:
+    one decode request saturates wave 0, so the late long prompt lands
+    in the naturally-empty wave 1. The filled arm prefills it through
+    that wave's idle ticks inside the already-running decode window
+    (first token at the window boundary); the unfilled arm must run a
+    standalone prefill ring dispatch over the full 128-wide bucket
+    between windows. Per-device that is ~2x the row-executions on the
+    request's critical path, which is what the 0.7x gate measures on
+    the 1-CPU serial CI box.
+
+    GATES (the preset refuses JSON on any miss):
+
+    - median mid-flight TTFT (filled) <= 0.7x median TTFT (unfilled),
+      best-window fallback under the PR-5 noise rule;
+    - cumulative pipeline-occupancy bubble (windows + standalone
+      prefill dispatches) STRICTLY lower on the filled arm;
+    - temp-0 tokens EXACT vs one-shot ``generate()`` on both arms,
+      every round;
+    - the timed rounds compile NOTHING on either arm (closed set);
+    - the filled arm actually bubble-filled (``fill_tokens > 0``) and
+      the unfilled arm did not (``fill_tokens == 0``).
+    """
+    import numpy as np
+
+    from elephas_tpu.serving import PPEngine
+
+    rng = np.random.default_rng(7)
+    prompt_a = rng.integers(1, 512, size=24).astype(np.int32)
+    prompt_late = rng.integers(1, 512, size=100).astype(np.int32)
+    bud_a, bud_late = 16, 6
+
+    def build(fill: bool) -> PPEngine:
+        # k=2, C=64: the 100-token prompt is ceil(100/64)=2 chunk
+        # rounds, so the fill completes inside ONE decode window and
+        # the first token rides that window's boundary
+        return PPEngine(
+            model, num_stages=2, wave_slots=2, model_parallel=2,
+            block_size=16, steps_per_wave=2,
+            bubble_fill=fill, bubble_chunk=64,
+        )
+
+    engines = {"filled": build(True), "unfilled": build(False)}
+
+    def drive(eng):
+        a = eng.submit(prompt_a, bud_a)
+        eng.step()  # A prefills + starts decoding: wave 0 saturated
+        late = eng.submit(prompt_late, bud_late)
+        guard = 0
+        while late.ttft is None:
+            eng.step()
+            guard += 1
+            if guard > 200:
+                raise ImplausibleTiming(
+                    "pp bubblefill gate: the mid-flight arrival never "
+                    "produced a token — the engine is not live"
+                )
+        while not (a.done and late.done):
+            eng.step()
+        return a, late
+
+    # warmup covers every compiled shape and proves token parity vs
+    # one-shot generate on BOTH arms
+    refs = {}
+    for name, eng in engines.items():
+        pair = drive(eng)
+        for req in pair:
+            ref = generate(
+                model, np.asarray(req.prompt, np.int32)[None],
+                steps=req.max_new_tokens, kv_cache=True,
+            )[0]
+            if not np.array_equal(
+                np.asarray(req.full_sequence, np.int32), ref
+            ):
+                raise ImplausibleTiming(
+                    f"pp bubblefill gate: {name} arm diverged from "
+                    f"one-shot generate at temp 0 — bubble-filled "
+                    f"serving is not token-exact"
+                )
+        refs[name] = [list(r.full_sequence) for r in pair]
+    if refs["filled"] != refs["unfilled"]:
+        raise ImplausibleTiming(
+            "pp bubblefill gate: filled and unfilled arms disagree at "
+            "temp 0 — the fill path changes tokens"
+        )
+    fill_warm = engines["filled"].stats()["fill_tokens"]
+    if not fill_warm:
+        raise ImplausibleTiming(
+            "pp bubblefill gate: the filled arm never bubble-filled "
+            "(fill_tokens == 0) — the mid-flight arrival took the "
+            "standalone prefill path"
+        )
+    if engines["unfilled"].stats()["fill_tokens"]:
+        raise ImplausibleTiming(
+            "pp bubblefill gate: the bubble_fill=False arm filled — "
+            "the knob does not gate the fill path"
+        )
+    compiles_warm = {
+        n: e.compile_stats() for n, e in engines.items()
+    }
+
+    ttfts = {"filled": [], "unfilled": []}
+    for _r in range(rounds):
+        for name, eng in engines.items():
+            pair = drive(eng)
+            for req, want in zip(pair, refs[name]):
+                if list(req.full_sequence) != want:
+                    raise ImplausibleTiming(
+                        f"pp bubblefill gate: {name} arm round "
+                        f"{_r} tokens diverged from the warmup pass"
+                    )
+            ttfts[name].append(pair[1].ttft)
+    for name, eng in engines.items():
+        if eng.compile_stats() != compiles_warm[name]:
+            raise ImplausibleTiming(
+                f"pp bubblefill gate: the timed rounds COMPILED on "
+                f"the {name} arm — the compiled-shape set is not "
+                f"closed under bubble fill"
+            )
+    # individual TTFTs can undercut the absolute window floor; the
+    # credibility unit here is the whole timed phase
+    if sum(ttfts["filled"]) + sum(ttfts["unfilled"]) <= MIN_CREDIBLE_DT:
+        raise ImplausibleTiming(
+            f"pp bubblefill gate: {2 * rounds} TTFT measurements sum "
+            f"below the {MIN_CREDIBLE_DT}s credibility floor"
+        )
+    ratio_rounds = [
+        f / u for f, u in zip(ttfts["filled"], ttfts["unfilled"])
+    ]
+    med_ratio = sorted(ratio_rounds)[(len(ratio_rounds) - 1) // 2]
+    best_ratio = min(ratio_rounds)
+    # PR-5 noise rule, TTFT flavor: ambient load swings rounds
+    # one-sidedly UP — when the spread says noise, the best window is
+    # the honest estimate
+    noisy = best_ratio > 0 and (
+        max(ratio_rounds) / best_ratio > 1.3
+    )
+    effective = best_ratio if (noisy and med_ratio > 0.7) else med_ratio
+    if effective > 0.7:
+        raise ImplausibleTiming(
+            f"pp bubblefill gate: mid-flight TTFT ratio "
+            f"{effective:.2f}x over the 0.7x ceiling (rounds "
+            f"{[round(r, 2) for r in ratio_rounds]}) — filling the "
+            f"bubble did not beat the between-window prefill"
+        )
+    bub = {
+        n: e.stats()["bubble_cumulative"] for n, e in engines.items()
+    }
+    if not (
+        bub["filled"] is not None
+        and bub["unfilled"] is not None
+        and bub["filled"] < bub["unfilled"]
+    ):
+        raise ImplausibleTiming(
+            f"pp bubblefill gate: cumulative bubble not strictly "
+            f"reduced (filled {bub['filled']} vs unfilled "
+            f"{bub['unfilled']})"
+        )
+
+    med = {
+        n: sorted(v)[(len(v) - 1) // 2] for n, v in ttfts.items()
+    }
+    log.info(
+        "pp bubblefill (median of %d rounds): mid-flight TTFT %.1f ms "
+        "filled vs %.1f ms unfilled (%.2fx, <=0.7x required; rounds "
+        "%s), cumulative bubble %.3f vs %.3f, token-exact",
+        rounds, med["filled"] * 1e3, med["unfilled"] * 1e3, effective,
+        [round(r, 2) for r in ratio_rounds],
+        bub["filled"], bub["unfilled"],
+    )
+    return {
+        "ttft_filled_ms": round(med["filled"] * 1e3, 3),
+        "ttft_unfilled_ms": round(med["unfilled"] * 1e3, 3),
+        "ttft_ratio": round(effective, 3),
+        "estimator": "best-window" if effective == best_ratio
+                     and effective != med_ratio else "median",
+        "ratio_rounds": [round(r, 3) for r in ratio_rounds],
+        "bubble_cumulative_filled": round(bub["filled"], 4),
+        "bubble_cumulative_unfilled": round(bub["unfilled"], 4),
+        "fill_tokens": int(
+            engines["filled"].stats()["fill_tokens"]
+        ),
+        "fill_rounds": int(
+            engines["filled"].stats()["fill_rounds"]
+        ),
+        "bubble_chunk": 64,
+        "token_exact": True,
+        "num_stages": 2,
+        "wave_slots": 2,
+        "steps_per_wave": 2,
+    }
+
+
 def measure_pp_serving(n_requests: int, rounds: int = 5):
     """``--preset pp`` (ISSUE 15): pipeline-parallel serving vs
     TP-only at EQUAL device count (4) and EQUAL per-device KV bytes —
@@ -2933,8 +3145,9 @@ def measure_pp_serving(n_requests: int, rounds: int = 5):
       and every arm's per-device KV bytes are equal.
 
     Reported alongside: the PP engine's pipeline bubble fraction
-    (the ``elephas_pp_bubble_fraction`` gauge) and per-arm round
-    throughputs.
+    (the ``elephas_pp_bubble_fraction`` gauge), per-arm round
+    throughputs, and the gated ``bubblefill`` section (ISSUE 16, see
+    :func:`_pp_bubblefill_section`).
     """
     import numpy as np
 
@@ -3097,6 +3310,8 @@ def measure_pp_serving(n_requests: int, rounds: int = 5):
             f"wave schedule's occupancy accounting is broken"
         )
 
+    bubblefill = _pp_bubblefill_section(model, generate, rounds=rounds)
+
     med = {
         name: sorted(v)[(len(v) - 1) // 2] for name, v in tps.items()
     }
@@ -3134,6 +3349,7 @@ def measure_pp_serving(n_requests: int, rounds: int = 5):
         "stage_budget_bytes": stage_budget_bytes,
         "pp_per_device_weight_bytes": pp_dev_w_bytes,
         "bubble_fraction": round(bubble, 4),
+        "bubblefill": bubblefill,
         "token_exact": True,
         "num_requests": n_requests,
         "ring_decode_compiles": compiles_warm["pp"][
@@ -3290,7 +3506,7 @@ def main():
         except ImplausibleTiming as e:
             log.error("ps bench implausible: %s — no JSON", e)
             sys.exit(1)
-        print(json.dumps(out))
+        emit_json(out)
         return
 
     if args.preset == "faults":
@@ -3321,7 +3537,7 @@ def main():
         except ImplausibleTiming as e:
             log.error("faults bench implausible: %s — no JSON", e)
             sys.exit(1)
-        print(json.dumps(out))
+        emit_json(out)
         return
 
     if args.preset == "fleet":
@@ -3337,7 +3553,7 @@ def main():
         except ImplausibleTiming as e:
             log.error("fleet bench implausible: %s — no JSON", e)
             sys.exit(1)
-        print(json.dumps(out))
+        emit_json(out)
         return
 
     if args.preset in ("serving", "pp"):
@@ -3375,7 +3591,7 @@ def main():
         except ImplausibleTiming as e:
             log.error("pp bench implausible: %s — no JSON", e)
             sys.exit(1)
-        print(json.dumps(out))
+        emit_json(out)
         return
 
     if preset == "serving":
@@ -3390,7 +3606,7 @@ def main():
         except ImplausibleTiming as e:
             log.error("serving bench implausible: %s — no JSON", e)
             sys.exit(1)
-        print(json.dumps(out))
+        emit_json(out)
         return
 
     from elephas_tpu.models import resnet, resnet50, transformer_classifier
@@ -3629,7 +3845,7 @@ def main():
             out.get("mfu"), dt,
         )
         sys.exit(1)
-    print(json.dumps(out))
+    emit_json(out)
 
 
 if __name__ == "__main__":

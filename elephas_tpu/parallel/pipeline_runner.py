@@ -677,6 +677,19 @@ class ServingStagePlan:
     def num_stages(self) -> int:
         return len(self.programs)
 
+    @property
+    def max_boundary_dim(self) -> int:
+        """Widest hidden row crossing the ring — what sizes the PP
+        engine's inter-stage buffers. One decode tick moves
+        ``wave_slots`` rows of this width; a chunked tick (prefill
+        ring, or a bubble-filled decode window — ISSUE 16) moves
+        ``wave_slots · chunk`` of them, so the window ring buffer is
+        ``wave_slots · bubble_chunk · max_boundary_dim`` floats and
+        every stage branch pads its boundary output to exactly that.
+        Logits never cross (sampling happens ON the last stage), so
+        the vocab does not enter."""
+        return max(self.boundary_dims)
+
     def stage_summary(self) -> list[list[str]]:
         return [[l.name for l in g] for g in self.layers]
 

@@ -33,7 +33,23 @@ iteration-level continuous batching, Orca, Yu et al. 2022):
 - prefill is the same ring with a chunk per wave: one dispatch walks
   an admission wave's (bucket-padded) prompts through all stages,
   landing each stage's K/V in its own pool and sampling first tokens
-  on the last stage.
+  on the last stage;
+- **bubble-filling chunked prefill** (ISSUE 16, ``bubble_fill=True``):
+  at tick ``t`` stage ``s`` is idle whenever wave ``(t − s) mod S``
+  has no decode work — an admission landing in such an EMPTY wave
+  becomes a *filler*: its prompt prefills chunk-by-chunk
+  (``bubble_chunk`` positions per ring round) through exactly those
+  idle ticks of the SAME compiled decode window, Sarathi-style
+  (Agrawal et al., 2024) piggybacking on a pipeline ring, so a
+  mid-flight long-prompt arrival reaches its first token without a
+  standalone prefill dispatch between windows;
+- **cross-stage prefix sharing** (``prefix_cache=True``): the
+  scheduler's :class:`PagedPrefixIndex` spans the per-stage pools for
+  free — ONE allocator leases each block id on EVERY stage, so a
+  prefix-hit splice makes the shared prompt's K/V resident on all
+  stages at once and both the prefill ring and the fill path start at
+  the shared offset (a shared system prompt pays one cold prefill
+  fleet-wide).
 
 Kept invariants (the standing serving contracts):
 
@@ -59,10 +75,11 @@ the block tables replicate and preemption offload gathers **per
 stage** (the offload record is the stage-stacked dense rows).
 
 Not in this engine (serve through :class:`~elephas_tpu.serving.\
-engine.InferenceEngine` for these): prefix cache, chunked-prefill
-budgets, speculative decoding, SLO policies, SP prefill, migration
-export/import. Preemption offload/resume IS here — pool pressure is
-where PP serving lives.
+engine.InferenceEngine` for these): speculative decoding, SLO
+policies, SP prefill, migration export/import. Preemption
+offload/resume IS here — pool pressure is where PP serving lives —
+and so are the paged prefix cache, bubble-fill chunked prefill, and
+``cancel()`` (ISSUE 16).
 """
 
 from __future__ import annotations
@@ -138,7 +155,26 @@ class PPEngine:
     ``preemption=True`` arms priority preempt → per-stage host
     offload → bit-exact resume. Submission/driving API mirrors
     ``InferenceEngine``: :meth:`submit`, :meth:`step`,
-    :meth:`stream`, :meth:`run`, :meth:`stats`.
+    :meth:`stream`, :meth:`run`, :meth:`cancel`, :meth:`stats`.
+
+    ``bubble_fill=True`` arms bubble-filling chunked prefill (ISSUE
+    16): an admission whose wave-aware slot lands in a wave with no
+    decode-active occupant (while another wave decodes) prefills
+    ``bubble_chunk`` prompt positions per ring round through that
+    wave's otherwise-idle decode-window ticks; ``bubble_budget`` caps
+    concurrent fill slots. Off by default: the combined window
+    program carries a per-wave fill/decode branch, so the default
+    engine keeps PR 15's program byte-for-byte. At temp 0 filled and
+    unfilled schedules are token-exact (argmax reads no PRNG); at
+    temp>0 they sample DIFFERENT streams — the window consumes one
+    key split per tick either way, but fill changes WHICH window
+    serves a token, hence which split it reads.
+
+    ``prefix_cache=True`` turns on the scheduler's refcounted
+    :class:`PagedPrefixIndex` over the per-stage pools: one block id
+    is resident on every stage, so a prefix hit splices shared
+    blocks fleet-wide and prefill (standalone or fill) starts at the
+    shared offset. ``prefix_min_reuse`` floors the match depth.
 
     Gang contract: like every serving surface, all gang processes must
     construct the engine identically and submit the identical request
@@ -154,7 +190,12 @@ class PPEngine:
                  top_k: int | None = None, top_p: float | None = None,
                  seed: int = 0, buckets=None,
                  preemption: bool = False,
-                 attention: str = "flash"):
+                 attention: str = "flash",
+                 bubble_fill: bool = False,
+                 bubble_chunk: int | None = None,
+                 bubble_budget: int | None = None,
+                 prefix_cache: bool = False,
+                 prefix_min_reuse: int = 1):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -256,6 +297,24 @@ class PPEngine:
         self._tbuckets = table_buckets(self.max_blocks_per_slot)
         self.preemption = bool(preemption)
 
+        # bubble-fill knobs (ISSUE 16): the chunk width is the fill
+        # path's per-round position count — it sizes the window ring
+        # buffer (ws·C·D_max), so the OFF engine pins C=1 and keeps
+        # PR 15's program byte-for-byte
+        self.bubble_fill = bool(bubble_fill)
+        C = bs if bubble_chunk is None else int(bubble_chunk)
+        if not 0 < C <= self.maxlen:
+            raise ValueError(
+                f"bubble_chunk={C} outside (0, maxlen={self.maxlen}]"
+            )
+        self.bubble_chunk = C
+        self._C = C if self.bubble_fill else 1
+        if bubble_budget is not None and int(bubble_budget) < 1:
+            raise ValueError(f"bubble_budget={bubble_budget} < 1")
+        self.bubble_budget = (
+            None if bubble_budget is None else int(bubble_budget)
+        )
+
         # -- telemetry captured at construction (the standing serving
         # contract: null-built engines stay inert for life) -----------
         treg = telemetry.registry()
@@ -302,6 +361,29 @@ class PPEngine:
             "Requests rejected at submit because prompt + "
             "max_new_tokens can never fit the block pool",
         )
+        self._m_cancelled = _c(
+            "elephas_serving_cancelled_total",
+            "In-flight requests cancelled before completion "
+            "(slot/blocks reclaimed; gateway client disconnects land "
+            "here)",
+        )
+        # bubble-fill + cross-stage prefix telemetry (ISSUE 16) —
+        # report-only like every serving series
+        self._m_fill_tokens = _c(
+            "elephas_pp_fill_tokens_total",
+            "Prompt tokens prefilled through idle decode-window ring "
+            "ticks (bubble fill)",
+        )
+        self._m_fill_rounds = _c(
+            "elephas_pp_fill_rounds_total",
+            "Fill-wave ring rounds carried inside decode windows "
+            "(idle ticks that did prefill work instead)",
+        )
+        self._m_prefix_shared = _c(
+            "elephas_pp_prefix_shared_tokens_total",
+            "Prompt tokens served by cross-stage prefix-block "
+            "splices (shared block ids resident on every stage)",
+        )
         self._m_ttft = treg.histogram(
             "elephas_serving_ttft_seconds",
             "Submit-to-first-token latency of served requests",
@@ -330,7 +412,7 @@ class PPEngine:
             "elephas_pp_bubble_fraction",
             "Pipeline-bubble fraction of the last decode window "
             "(idle stage-ticks / scheduled stage-ticks; ramp + drain "
-            "+ empty waves)",
+            "+ empty waves; bubble-filled ticks count as useful)",
             labels=("engine",),
         ).labels(engine=eid)
         self._mf_wave_active = treg.gauge(
@@ -353,7 +435,8 @@ class PPEngine:
         self.scheduler = Scheduler(
             self.num_slots, buckets or default_buckets(self.maxlen),
             allocator=allocator, preemption=preemption,
-            wave_slots=ws,
+            wave_slots=ws, prefix_cache=bool(prefix_cache),
+            prefix_min_reuse=prefix_min_reuse,
         )
         self._seed = int(seed)
         self.finished: dict[int, Request] = {}
@@ -363,6 +446,17 @@ class PPEngine:
         self._active_host = np.zeros((self.num_slots,), bool)
         self._tables_cache: tuple | None = None
         self._last_bubble = 0.0
+        # bubble-fill host state: slot → next prompt offset to fill
+        # (in-progress fillers), and slots whose prompt finished
+        # filling but whose WAVE still has fillers in flight
+        # (whole-wave graduation — see _decode_window)
+        self._filling: dict[int, int] = {}
+        self._fill_done: set[int] = set()
+        # cumulative ring accounting (stage-ticks scheduled vs
+        # carrying work, windows AND standalone prefill dispatches) —
+        # stats()['bubble_cumulative'], report-only
+        self._ticks_sched = 0
+        self._ticks_useful = 0
         self._trace_compiles = not telemetry.null_mode()
 
         # -- stage weights: per-stage (per-rank under TP) {path: value}
@@ -522,13 +616,17 @@ class PPEngine:
     def refresh_weights(self) -> None:
         """Re-upload the model's weights after further training (the
         compiled ring programs take them as arguments — no
-        recompile)."""
+        recompile). Flushes the prefix index: rows indexed under the
+        old weights are stale K/V for the new ones."""
         tracer = getattr(self, "_tracer", None)
         if tracer is not None:
             tracer.emit(
                 "serve.refresh_weights", engine=self.telemetry_label,
             )
         self._build_stage_weights()
+        sched = getattr(self, "scheduler", None)
+        if sched is not None:
+            sched.flush_prefix_cache()
 
     # -- stage branch construction --------------------------------------
 
@@ -798,9 +896,15 @@ class PPEngine:
         # the ring buffer carries per-position hidden rows between
         # stages and sampled tokens on the wrap edge; logits never
         # cross (sampling happens ON the last stage), so the buffer is
-        # sized by the widest hidden boundary, not the vocab
-        D_max = max(plan.boundary_dims)
-        B_dec = ws * D_max
+        # sized by the widest hidden boundary, not the vocab. With
+        # bubble-fill armed a fill round moves ws·C hidden rows per
+        # tick (C = bubble_chunk); the OFF engine's C is pinned to 1,
+        # so its window buffer — and whole program — stays PR 15's
+        # byte-for-byte
+        D_max = plan.max_boundary_dim
+        enable_fill = self.bubble_fill
+        Cf = self._C
+        B_dec = ws * Cf * D_max
         param_spec = self._param_spec
         pool_spec = self._pool_spec
 
@@ -838,14 +942,93 @@ class PPEngine:
 
             return branch
 
+        def make_fill_branch(s: int):
+            # the window-resident sibling of make_chunk_branch: one
+            # Cf-wide chunk of a filler wave's prompts per ring round,
+            # positions (fill offset + round·Cf) + arange(Cf), valid
+            # while inside the prompt; the LAST valid position samples
+            # the first token exactly like the prefill ring's at_end
+            # row, and it rides the same outputs[(round, slot)] write
+            nodes, in_kt, out_kt = plan.programs[s]
+            first, last = s == 0, s == S - 1
+            D_in = None if first else plan.boundary_dims[s - 1]
+            unravel, p_size = unravels[s], p_sizes[s]
+
+            def branch(p, rows, recv, pk, pv, offs_w, act_w,
+                       p_lens_w, temps_w, tab_w, sub):
+                pos_mat = offs_w[:, None] + jnp.arange(Cf)[None, :]
+                valid = act_w[:, None] & (
+                    pos_mat < p_lens_w[:, None]
+                )
+                ctx = {
+                    "w": unravel(p[:p_size]),
+                    "pk": pk, "pv": pv, "updated": {},
+                    "pos_mat": pos_mat, "valid": valid,
+                    "tables": tab_w,
+                }
+                handler = self._make_stage_handler(s, "chunk", ctx)
+                x = rows if first else (
+                    recv[: ws * Cf * D_in].reshape(ws, Cf, D_in)
+                )
+                out = _replay_nodes(nodes, in_kt, out_kt, x, handler)
+                for li, (nk, nv) in sorted(ctx["updated"].items()):
+                    pk = pk.at[li].set(nk)
+                    pv = pv.at[li].set(nv)
+                if last:
+                    at_end = (
+                        valid & (pos_mat == (p_lens_w - 1)[:, None])
+                    ).astype(out.dtype)
+                    row = jnp.einsum("wc,wcv->wv", at_end, out)
+                    firsts = _sample_dynamic(
+                        row, sub, temps_w, top_k, top_p
+                    )
+                    flat = firsts.astype(jnp.float32)
+                else:
+                    flat = out.reshape(-1)
+                return (
+                    jnp.pad(flat, (0, B_dec - flat.size)), pk, pv,
+                )
+
+            return branch
+
+        def make_combined_branch(s: int):
+            dec = make_decode_branch(s)
+            fil = make_fill_branch(s)
+
+            def branch(p, tok_in, recv, pk, pv, pos_w, act_w,
+                       temps_w, tab_w, sub, fw, f_rows, f_offs_w,
+                       f_plens_w):
+                # waves are pure (all-fill or all-decode — the
+                # scheduler and _demote_stranded enforce it), so one
+                # cond per (stage, tick) picks the wave's mode; the
+                # decode side is the PR 15 branch untouched
+                return jax.lax.cond(
+                    fw,
+                    lambda: fil(
+                        p, f_rows, recv, pk, pv, f_offs_w, act_w,
+                        f_plens_w, temps_w, tab_w, sub,
+                    ),
+                    lambda: dec(
+                        p, tok_in, recv, pk, pv, pos_w, act_w,
+                        temps_w, tab_w, sub,
+                    ),
+                )
+
+            return branch
+
         decode_branches = [make_decode_branch(s) for s in range(S)]
+        combined_branches = [
+            make_combined_branch(s) for s in range(S)
+        ]
 
         def ring_decode(wflat, pk, pv, tables, lengths0, last0,
-                        temps, active, key):
+                        temps, active, fill_wave, fill_offs,
+                        fill_tokens, fill_plens, key):
             T = int(tables.shape[1])
 
             def per_device(wflat, pk, pv, tables, lengths0, last0,
-                           temps, active, key):
+                           temps, active, fill_wave, fill_offs,
+                           fill_tokens, fill_plens, key):
                 stage = jax.lax.axis_index("stages")
                 p = wflat.reshape(wflat.shape[-1])
                 pk, pv = pk[0], pv[0]
@@ -854,6 +1037,7 @@ class PPEngine:
                     recv, pk, pv, outputs, key = carry
                     w_idx = (t - stage) % S
                     j = (t - stage) // S
+                    jc = jnp.clip(j, 0, k - 1)
                     processing = (t >= stage) & (j < k)
                     off = w_idx * ws
                     lens_w = jax.lax.dynamic_slice(
@@ -879,17 +1063,38 @@ class PPEngine:
                         j == 0, last_w, recv[:ws].astype(jnp.int32)
                     )
                     key, sub = jax.random.split(key)
-                    out_flat, pk, pv = jax.lax.switch(
-                        stage,
-                        [
-                            (lambda *a, _br=br: _br(*a))
-                            for br in decode_branches
-                        ],
-                        p, tok_in, recv, pk, pv, pos_w, act_w,
-                        temps_w, tab_w, sub,
-                    )
+                    if enable_fill:
+                        fw = fill_wave[w_idx]
+                        f_offs_w = jax.lax.dynamic_slice(
+                            fill_offs, (off,), (ws,)
+                        ) + jc * Cf
+                        f_rows = jax.lax.dynamic_slice(
+                            fill_tokens, (off, jc * Cf), (ws, Cf)
+                        )
+                        f_plens_w = jax.lax.dynamic_slice(
+                            fill_plens, (off,), (ws,)
+                        )
+                        out_flat, pk, pv = jax.lax.switch(
+                            stage,
+                            [
+                                (lambda *a, _br=br: _br(*a))
+                                for br in combined_branches
+                            ],
+                            p, tok_in, recv, pk, pv, pos_w, act_w,
+                            temps_w, tab_w, sub, fw, f_rows,
+                            f_offs_w, f_plens_w,
+                        )
+                    else:
+                        out_flat, pk, pv = jax.lax.switch(
+                            stage,
+                            [
+                                (lambda *a, _br=br: _br(*a))
+                                for br in decode_branches
+                            ],
+                            p, tok_in, recv, pk, pv, pos_w, act_w,
+                            temps_w, tab_w, sub,
+                        )
                     toks = out_flat[:ws].astype(jnp.int32)
-                    jc = jnp.clip(j, 0, k - 1)
                     upd = jax.lax.dynamic_update_slice(
                         outputs, toks[None, :], (jc, off)
                     )
@@ -914,11 +1119,12 @@ class PPEngine:
                 per_device,
                 mesh=mesh,
                 in_specs=(param_spec, pool_spec, pool_spec,
-                          P(), P(), P(), P(), P(), P()),
+                          P(), P(), P(), P(), P(), P(), P(), P(),
+                          P(), P()),
                 out_specs=(pool_spec, pool_spec, P("stages"), P()),
                 check=False,
             )(wflat, pk, pv, tables, lengths0, last0, temps, active,
-              key)
+              fill_wave, fill_offs, fill_tokens, fill_plens, key)
 
         self._decode_ring_jit = jax.jit(
             ring_decode, donate_argnums=(1, 2)
@@ -1343,34 +1549,71 @@ class PPEngine:
 
     # -- execution ------------------------------------------------------
 
+    def _account_ring(self, stage_ticks: int, useful: int) -> None:
+        """Cumulative ring-time accounting (report-only): every ring
+        dispatch — decode window or standalone prefill — schedules
+        ``stage_ticks`` stage-ticks of which ``useful`` carried wave
+        work; ``stats()['bubble_cumulative']`` is the lifetime idle
+        fraction, the number the bubble-fill bench compares across
+        arms (a filled arm simply never schedules the standalone
+        prefill dispatch's mostly-idle ticks)."""
+        self._ticks_sched += int(stage_ticks)
+        self._ticks_useful += int(useful)
+
     def _prefill_wave(self, fresh):
         """Prefill an admission wave through the ring: one dispatch
         per prompt-width bucket walks every admitted slot's prompt
         through all stages (wave by wave), lands each stage's K/V in
-        its own pool, and samples first tokens on the last stage."""
-        emitted = []
-        by_width: dict[int, list] = {}
+        its own pool, and samples first tokens on the last stage.
+
+        Suffix-bucketed (ISSUE 16): a prefix-index hit spliced blocks
+        that are ALREADY resident on every stage (one allocator, one
+        id fleet-wide), so the ring starts at the shared offset and
+        buckets by the remaining suffix — the flat engine's rule."""
+        items = []
         for a in fresh:
+            if a.shared_len:
+                self._m_prefix_shared.inc(a.shared_len)
+            items.append((a.req, a.slot, a.shared_len))
+        return self._ring_prefill_items(items, demoted=False)
+
+    def _ring_prefill_items(self, items, demoted: bool):
+        """Run the offset-capable prefill ring over ``(req, slot,
+        offset)`` items — admission waves (offset = shared prefix) and
+        bubble-fill demotions (offset = fill progress) share one
+        dispatch path, so both complete the prompt, register it with
+        the prefix index, and emit the first token identically."""
+        emitted = []
+        sched = self.scheduler
+        S, ws = self.num_stages, self.wave_slots
+        by_width: dict[int, list] = {}
+        for req, slot, off in items:
             by_width.setdefault(
-                self.scheduler.bucket_for(len(a.req.prompt)), []
-            ).append(a)
+                sched.bucket_for(len(req.prompt) - off), []
+            ).append((req, slot, off))
         for width in sorted(by_width):
-            adms = by_width[width]
+            group = by_width[width]
             tokens = np.zeros((self.num_slots, width), np.int32)
             offs = np.zeros((self.num_slots,), np.int32)
             clens = np.zeros((self.num_slots,), np.int32)
             act = np.zeros((self.num_slots,), bool)
             p_lens = np.ones((self.num_slots,), np.int32)
             temps = np.zeros((self.num_slots,), np.float32)
-            for a in adms:
-                req = a.req
-                tokens[a.slot, : len(req.prompt)] = req.prompt
-                clens[a.slot] = len(req.prompt)
-                act[a.slot] = True
-                p_lens[a.slot] = len(req.prompt)
-                temps[a.slot] = req.temperature
+            for req, slot, off in group:
+                suffix = req.prompt[off:]
+                tokens[slot, : len(suffix)] = suffix
+                offs[slot] = off
+                clens[slot] = len(suffix)
+                act[slot] = True
+                p_lens[slot] = len(req.prompt)
+                temps[slot] = req.temperature
+            # a standalone prefill dispatch is ring time too: 2S−1
+            # ticks in which only the occupied waves carry work
+            waves_used = len({slot // ws for _r, slot, _o in group})
+            self._account_ring(S * (2 * S - 1), waves_used * S)
             with self._tracer.span(
-                "serve.prefill_wave", reqs=len(adms), width=width,
+                "serve.prefill_wave", reqs=len(group), width=width,
+                demoted=bool(demoted),
             ):
                 (self._pk, self._pv, firsts, self._key) = (
                     self._dispatch(
@@ -1386,15 +1629,18 @@ class PPEngine:
                     )
                 )
                 toks = self._host(firsts)[self.num_stages - 1]
-            for a in adms:
-                req = a.req
-                self._active_host[a.slot] = True
+            for req, slot, off in group:
+                self._active_host[slot] = True
                 self._tracer.emit(
                     "serve.prefill", rid=req.rid, bucket=width,
-                    prompt_tokens=len(req.prompt),
-                    step=self.scheduler._steps,
+                    prompt_tokens=len(req.prompt), offset=int(off),
+                    step=sched._steps,
                 )
-                self._emit(req, int(toks[a.slot]))
+                # register the completed prompt with the prefix index
+                # BEFORE emitting: a budget-1 request reclaims its
+                # table inside _emit, and insert() needs it live
+                sched.on_prefill_complete(req)
+                self._emit(req, int(toks[slot]))
                 emitted.append((req, req.tokens[-1], req.done))
         return emitted
 
@@ -1402,37 +1648,85 @@ class PPEngine:
         """One compiled window of ``S·k + S − 1`` ring ticks: every
         wave advances ``k`` tokens, stages overlap on different waves
         (the bubble-filling schedule), host state re-arms from truth
-        at the boundary."""
+        at the boundary.
+
+        Bubble-fill (ISSUE 16): waves flagged in ``fill_wave`` run
+        the CHUNK branch on their ticks instead of decode — each of
+        the window's ``k`` rounds advances every filler in the wave
+        by ``bubble_chunk`` prompt positions (from its ``_filling``
+        offset). A filler whose remaining prompt fits this window
+        samples its first token at round ``ceil(remaining/C) − 1``
+        and parks in ``_fill_done`` until the WHOLE wave finished
+        filling (whole-wave graduation — graduating one slot early
+        would flip the wave to decode and strand its co-fillers
+        mid-prompt); then the wave decodes normally from the next
+        window."""
         sched = self.scheduler
         S, ws, k = self.num_stages, self.wave_slots, self.steps_per_wave
+        C = self._C
+        filling = dict(self._filling)
+        skip = set(filling) | self._fill_done
         lengths0 = np.zeros((self.num_slots,), np.int32)
         last0 = np.zeros((self.num_slots,), np.int32)
         temps = np.zeros((self.num_slots,), np.float32)
         for slot, req in sched.active.items():
+            temps[slot] = req.temperature
+            if slot in skip:
+                continue  # fillers have no sampled token yet
             lengths0[slot] = len(req.prompt) + len(req.tokens) - 1
             last0[slot] = req.tokens[-1]
-            temps[slot] = req.temperature
         active = self._active_host.copy()
+        fill_wave = np.zeros((S,), bool)
+        fill_offs = np.zeros((self.num_slots,), np.int32)
+        fill_tokens = np.zeros((self.num_slots, k * C), np.int32)
+        fill_plens = np.ones((self.num_slots,), np.int32)
+        fill_rounds = [0] * S
+        fill_toks = 0
+        for slot, off in sorted(filling.items()):
+            req = sched.active[slot]
+            w = slot // ws
+            pl = len(req.prompt)
+            fill_wave[w] = True
+            fill_offs[slot] = off
+            seg = req.prompt[off: off + k * C]
+            fill_tokens[slot, : len(seg)] = seg
+            fill_plens[slot] = pl
+            active[slot] = True
+            rounds = min(-(-(pl - off) // C), k)
+            fill_rounds[w] = max(fill_rounds[w], rounds)
+            fill_toks += len(seg)
+        for slot in self._fill_done:
+            # completed co-fillers keep their wave in fill mode (the
+            # chunk branch idles them via the valid mask) until the
+            # whole wave graduates
+            fill_wave[slot // ws] = True
         # report-only wave occupancy + bubble fraction: ramp/drain
         # ticks plus whole-window ticks of EMPTY waves carry no wave
-        # work; telemetry observes, never drives
+        # work — but rounds a fill wave spends on prefill chunks DO;
+        # telemetry observes, never drives
         wave_live = [
-            int(active[w * ws:(w + 1) * ws].sum()) for w in range(S)
+            int(self._active_host[w * ws:(w + 1) * ws].sum())
+            for w in range(S)
         ]
         nonempty = sum(1 for n in wave_live if n)
         ticks = S * k + S - 1
-        useful = nonempty * S * k
+        useful = nonempty * S * k + sum(fill_rounds) * S
         bubble = 1.0 - useful / float(S * ticks)
         self._last_bubble = bubble
         self._m_bubble.set(bubble)
+        self._account_ring(S * ticks, useful)
         for w, n in enumerate(wave_live):
             self._mf_wave_active.labels(
                 engine=self.telemetry_label, wave=str(w)
             ).set(n)
+        if fill_toks:
+            self._m_fill_tokens.inc(fill_toks)
+            self._m_fill_rounds.inc(sum(fill_rounds))
         emitted = []
         with self._tracer.span(
             "serve.wave", waves=S, steps=k,
             active=len(sched.active), bubble=round(bubble, 4),
+            fill_slots=len(filling), fill_tokens=fill_toks,
         ):
             self._m_decode_windows.inc()
             (self._pk, self._pv, outputs, self._key) = self._dispatch(
@@ -1440,7 +1734,12 @@ class PPEngine:
                 self._wflat, self._pk, self._pv,
                 self._staged_tables(), self._stage_host(lengths0),
                 self._stage_host(last0), self._stage_host(temps),
-                self._stage_host(active), self._key,
+                self._stage_host(active),
+                self._stage_host(fill_wave),
+                self._stage_host(fill_offs),
+                self._stage_host(fill_tokens),
+                self._stage_host(fill_plens),
+                self._key,
             )
             toks = self._host(outputs)[S - 1]  # [k, num_slots]
             for i in range(k):
@@ -1448,29 +1747,198 @@ class PPEngine:
                     break
                 sched.note_step()
                 for slot, req in sorted(sched.active.items()):
+                    if slot in skip:
+                        continue
                     done = self._emit(req, int(toks[i, slot]))
                     emitted.append((req, req.tokens[-1], done))
+        # fill advancement/completion at the window boundary
+        for slot in sorted(filling):
+            req = sched.active.get(slot)
+            if req is None or slot not in self._filling:
+                continue  # cancelled mid-window
+            off = self._filling[slot]
+            pl = len(req.prompt)
+            rounds = -(-(pl - off) // C)  # ceil
+            if rounds <= k:
+                del self._filling[slot]
+                self._tracer.emit(
+                    "serve.fill_complete", rid=req.rid, slot=slot,
+                    prompt_tokens=pl, step=sched._steps,
+                )
+                sched.on_prefill_complete(req)
+                done = self._emit(req, int(toks[rounds - 1, slot]))
+                emitted.append((req, req.tokens[-1], done))
+                if not done:
+                    self._fill_done.add(slot)
+            else:
+                self._filling[slot] = off + k * C
+        # whole-wave graduation: a fill wave with no in-progress
+        # fillers left starts decoding at the NEXT window
+        for slot in sorted(self._fill_done):
+            w = slot // ws
+            if not any(s // ws == w for s in self._filling):
+                self._fill_done.discard(slot)
+                self._active_host[slot] = True
+        return emitted
+
+    def _start_fill(self, a) -> None:
+        """Arm bubble-fill for one admission: the prompt (its suffix,
+        after a prefix-hit splice — shared blocks are resident on
+        every stage already) prefills through the slot's wave during
+        coming decode windows' idle ticks instead of a standalone
+        ring dispatch. The slot stays decode-inactive until its wave
+        graduates."""
+        req = a.req
+        if a.shared_len:
+            self._m_prefix_shared.inc(a.shared_len)
+        self._filling[a.slot] = int(a.shared_len)
+        self._tracer.emit(
+            "serve.fill_admit", rid=req.rid, slot=a.slot,
+            prompt_tokens=len(req.prompt), shared=int(a.shared_len),
+            step=self.scheduler._steps,
+        )
+
+    def _demote_stranded(self):
+        """Bubble-fill liveness: fillers whose wave can no longer run
+        as a PURE fill wave finish their prompts NOW through the
+        offset prefill ring. Two strandings exist: (a) a decode-
+        active occupant landed in the fill wave (a resume's wave-
+        aware slot, or a budget-overflow admission) — each ring tick
+        runs ONE branch per wave, so mixed waves are unschedulable;
+        (b) no decode-active wave remains anywhere, so no window
+        would ever carry the fill. Demotion re-enters the standing
+        prefill path at the CURRENT offset — chunk K/V already
+        written stays valid, only the remaining suffix rings."""
+        if not self._filling and not self._fill_done:
+            return []
+        ws = self.wave_slots
+        decode_waves = {
+            int(s) // ws for s in np.flatnonzero(self._active_host)
+        }
+        demote = [
+            slot for slot in sorted(self._filling)
+            if slot // ws in decode_waves or not decode_waves
+        ]
+        emitted = []
+        if demote:
+            items = []
+            for slot in demote:
+                off = self._filling.pop(slot)
+                req = self.scheduler.active[slot]
+                self._tracer.emit(
+                    "serve.fill_demote", rid=req.rid, slot=slot,
+                    offset=int(off), step=self.scheduler._steps,
+                )
+                items.append((req, slot, off))
+            emitted = self._ring_prefill_items(items, demoted=True)
+        # completed fillers in a wave that is decoding anyway (or
+        # whose last in-progress filler just demoted) graduate now
+        for slot in sorted(self._fill_done):
+            w = slot // ws
+            if w in decode_waves or not any(
+                s // ws == w for s in self._filling
+            ):
+                self._fill_done.discard(slot)
+                self._active_host[slot] = True
         return emitted
 
     def step(self):
         """One engine iteration: paged admission (preemption offloads
-        first, resumes restored, fresh admissions ring-prefilled),
-        then one microbatched decode window. Returns ``(request,
-        token, done)`` triples in generation order."""
+        first, resumes restored, bubble-fill admissions armed, fresh
+        admissions ring-prefilled, stranded fillers demoted), then
+        one microbatched decode window. Returns ``(request, token,
+        done)`` triples in generation order."""
         emitted = []
-        plan, preempts = self.scheduler.admit_paged()
+        fillers = frozenset(self._filling) | frozenset(self._fill_done)
+        plan, preempts = self.scheduler.admit_paged(
+            prefilling=fillers,
+            bubble_fill=self.bubble_fill,
+            fill_budget=self.bubble_budget,
+        )
         for pre in preempts:
             self._offload(pre)
+            # a parked-but-complete filler may be a victim; its fill
+            # state dies with the slot (the offload record has the
+            # full prompt K/V — resume restores it as plain decode)
+            self._fill_done.discard(pre.slot)
         if plan:
             for a in plan:
                 if a.resume is not None:
                     self._resume(a)
-            fresh = [a for a in plan if a.resume is None]
+            for a in plan:
+                if a.resume is None and a.fill:
+                    self._start_fill(a)
+            fresh = [
+                a for a in plan if a.resume is None and not a.fill
+            ]
             if fresh:
                 emitted.extend(self._prefill_wave(fresh))
+        emitted.extend(self._demote_stranded())
         if self.scheduler.active:
             emitted.extend(self._decode_window())
         return emitted
+
+    def _notify_stream_end(self, req: Request) -> None:
+        """Tell a request's live stream it ENDED without a final
+        engine token — ``on_token(None, True)``; without it a
+        consumer blocking on the stream (the gateway's SSE handlers)
+        waits forever on cancel."""
+        cb = req.on_token
+        if cb is not None:
+            try:
+                cb(None, True)
+            except BaseException:
+                logger.warning(
+                    "request %d stream-end callback failed",
+                    req.rid, exc_info=True,
+                )
+
+    def cancel(self, rid: int) -> bool:
+        """Abort one in-flight request and reclaim its wave slot and
+        blocks at the window boundary — flat-engine parity (the
+        gateway's ``POST /v1/requests/{rid}/cancel`` route and SSE
+        disconnects call this on whichever engine serves). A waiting
+        request leaves the queue (a preempted one also drops its
+        per-stage offload record); an active one frees its slot and
+        table, clearing any bubble-fill state. Returns True when the
+        rid was live (``req.done`` flips with ``req.error`` set to
+        :class:`RequestCancelled`), False when unknown or already
+        finished.
+
+        Gang contract: every gang process must issue the identical
+        cancel sequence at the identical step boundaries."""
+        from elephas_tpu.serving.engine import RequestCancelled
+
+        rid = int(rid)
+        sched = self.scheduler
+        req = sched.remove_waiting(rid)
+        if req is not None:
+            self._offloaded.pop(rid, None)
+        else:
+            slot = next(
+                (s for s, r in sched.active.items() if r.rid == rid),
+                None,
+            )
+            if slot is None:
+                return False
+            req = sched.active[slot]
+            self._filling.pop(slot, None)
+            self._fill_done.discard(slot)
+            sched.reclaim(slot)
+            self._active_host[slot] = False
+        req.done = True
+        req.error = RequestCancelled(f"request {rid} cancelled")
+        # a live stream must UNBLOCK, not hang: cancel never delivers
+        # a final token, so send the explicit end sentinel
+        self._notify_stream_end(req)
+        self._m_cancelled.inc()
+        self._tracer.emit(
+            "serve.cancel", rid=rid, tokens=len(req.tokens),
+            step=sched._steps,
+        )
+        self.finished[rid] = req
+        self._evict_finished()
+        return True
 
     def stream(self):
         while self.scheduler.has_work:
@@ -1539,6 +2007,9 @@ class PPEngine:
             "num_blocks": self.num_blocks,
             "model_parallel": self.model_parallel,
             "attention": self.attention,
+            "bubble_fill": self.bubble_fill,
+            "bubble_chunk": self._C,
+            "prefix_cache": self.scheduler.prefix_index is not None,
         }
 
     def stats(self) -> dict:
@@ -1583,6 +2054,14 @@ class PPEngine:
             "blocks_total": self.num_blocks,
             "blocks_free": self.scheduler.allocator.free_count,
             "bubble_fraction": self._last_bubble,
+            "bubble_cumulative": (
+                1.0 - self._ticks_useful / self._ticks_sched
+                if self._ticks_sched else None
+            ),
+            "fill_tokens": int(self._m_fill_tokens.value),
+            "fill_rounds": int(self._m_fill_rounds.value),
+            "prefix_shared_tokens": int(self._m_prefix_shared.value),
+            "cancelled": int(self._m_cancelled.value),
         }
 
     def scrape(self, full: bool = True) -> str:

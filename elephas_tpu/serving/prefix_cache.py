@@ -339,7 +339,14 @@ class PagedPrefixIndex:
     - eviction under pool pressure (:meth:`evict_for`) drops LRU
       entries whose blocks would actually free (refcount 1) —
       releasing an entry shared with live tables frees nothing and is
-      skipped.
+      skipped;
+    - under the PP engine (ISSUE 16) the same index spans the
+      PER-STAGE pools CROSS-STAGE for free: the shared allocator
+      leases one block id across all stages (every stage stores its
+      layers' rows at that id in its own pool), so one spliced id
+      skips the prefix's chunks on EVERY stage at once — block-id
+      lists are mesh-layout-agnostic, which is why neither this index
+      nor the allocator knows whether it serves a flat or a PP arena.
 
     Same determinism rules as :class:`PrefixCache`: logical clock
     recency, entry-id tie-breaks, :meth:`match` is PURE (commit happens

@@ -178,6 +178,11 @@ class Admission:
     blocks: list | None = None
     shared_len: int = 0
     resume: "Preemption | None" = None
+    # bubble-fill admission (ISSUE 16): the slot landed in a wave with
+    # no decode-active occupants, so the PP engine prefills this
+    # request through that wave's idle decode-window ticks instead of
+    # dispatching a standalone prefill ring between windows
+    fill: bool = False
 
 
 @dataclass
@@ -527,7 +532,8 @@ class Scheduler:
             self.allocator.block_size,
         )
 
-    def admit_paged(self, prefilling=frozenset()):
+    def admit_paged(self, prefilling=frozenset(), bubble_fill: bool = False,
+                    fill_budget: int | None = None):
         """Paged admission wave: FIFO head-blocking like :meth:`admit`,
         but a request needs BOTH a free slot and its full block
         reservation. Shortfalls resolve in deterministic order: evict
@@ -539,6 +545,20 @@ class Scheduler:
         victim would not admit it (no thrash for nothing). ``prefilling``
         slots are never victims (their tables are mid-write).
 
+        Bubble-fill (ISSUE 16, PP engine only): with ``bubble_fill``
+        on, a FRESH admission whose wave-aware slot lands in a wave
+        with NO decode-active occupant — while at least one decode-
+        active wave exists elsewhere to open windows — is flagged
+        ``Admission.fill``: the engine prefills it through that wave's
+        idle decode-window ticks instead of a standalone prefill ring.
+        ``fill_budget`` caps concurrent fill slots (None = one wave's
+        worth is the engine's practical bound). ``prefilling`` doubles
+        as the current filler set: its members count as NON-decode
+        occupants for the wave test and are never preemption victims.
+        Resumes are never flagged (their K/V is already resident —
+        there is nothing to prefill). With ``bubble_fill`` False the
+        admission plan is byte-identical to PR 15.
+
         Returns ``(admissions, preemptions)``; the engine MUST offload
         every preemption's blocks before running any pool-writing
         program, then execute the admissions."""
@@ -546,6 +566,9 @@ class Scheduler:
             raise RuntimeError("admit_paged() on a non-paged scheduler")
         admitted: list[Admission] = []
         preempts: list[Preemption] = []
+        # fillers seen by the wave test: the engine's current fill
+        # slots plus any admission THIS wave already flagged
+        fillers: set[int] = set(prefilling)
         # rids admitted by THIS wave — never preemption victims within
         # it (their Admission is already in the returned plan; see
         # _plan_preemption)
@@ -593,6 +616,25 @@ class Scheduler:
             own = alloc.alloc(own_need)
             assert own is not None  # guaranteed by the short check
             slot = self._pop_free_slot()
+            fill = False
+            if (bubble_fill and self.wave_slots is not None
+                    and record is None):
+                ws = self.wave_slots
+                decode_slots = [
+                    s for s in self.active if s not in fillers
+                ]
+                wave_has_decode = any(
+                    s // ws == slot // ws for s in decode_slots
+                )
+                budget_ok = (
+                    fill_budget is None or len(fillers) < int(fill_budget)
+                )
+                # fillable only when some OTHER wave is decoding —
+                # without a decode-active wave no window would ever
+                # run, and the filler would starve
+                if decode_slots and not wave_has_decode and budget_ok:
+                    fill = True
+                    fillers.add(slot)
             self.tables[slot] = shared + own
             self.tables_version += 1
             req.slot = slot
@@ -611,7 +653,7 @@ class Scheduler:
                  else self._m_admit_cold).inc()
                 admitted.append(Admission(
                     req=req, slot=slot, blocks=self.tables[slot],
-                    shared_len=reuse,
+                    shared_len=reuse, fill=fill,
                 ))
         self._m_waiting.set(len(self.waiting))
         return admitted, preempts

@@ -29,6 +29,21 @@ logger = logging.getLogger(__name__)
 
 _CPU_FLAG = "--xla_force_host_platform_device_count"
 
+# record of the last in-process CPU fallback (None = discovery
+# succeeded on the wanted platform): {"wanted", "got", "reason"}.
+# Entry points surface it loudly — bench.py writes it into every
+# artifact's JSON as "backend_fallback" so an rc=0 CPU-fallback run
+# is distinguishable from a healthy accelerator run (the BENCH_r05
+# make_c_api_client crash produced NO artifact at all before this)
+_fallback: dict | None = None
+
+
+def last_fallback() -> dict | None:
+    """The last :func:`ensure_backend` CPU fallback in this process
+    (``{"wanted", "got", "reason"}``), or None when discovery came up
+    on the wanted platform."""
+    return _fallback
+
 
 def set_host_device_count_flag(n: int) -> None:
     """Put the XLA host-platform device-count flag in the environment
@@ -65,11 +80,14 @@ def force_cpu_devices(n: int) -> None:
     clear_backends()
 
 
-def _probe_backend(timeout: float):
+def _probe_backend(timeout: float, reason: list | None = None):
     """``jax.default_backend()`` in a daemon thread with a deadline.
 
     Returns the backend name, or ``None`` when the probe hung past
-    ``timeout`` or raised (a dead tunnel shows up both ways)."""
+    ``timeout`` or raised (a dead tunnel shows up both ways — the
+    BENCH_r05 ``make_c_api_client`` plugin-init crash is the raise
+    flavor). When ``reason`` is given the failure cause is appended to
+    it so callers can surface *why* discovery fell back."""
     import jax
 
     box: list = []
@@ -79,12 +97,16 @@ def _probe_backend(timeout: float):
             box.append(jax.default_backend())
         except Exception as e:  # noqa: BLE001 — any init failure → fallback
             logger.warning("backend probe raised: %s", e)
+            if reason is not None:
+                reason.append(f"probe raised {type(e).__name__}: {e}")
 
     t = threading.Thread(target=probe, daemon=True, name="backend-probe")
     t.start()
     t.join(timeout)
     if t.is_alive():
         logger.warning("backend probe still hung after %.0fs", timeout)
+        if reason is not None:
+            reason.append(f"probe hung past {timeout:.0f}s")
         return None
     return box[0] if box else None
 
@@ -113,6 +135,7 @@ def ensure_backend(timeout: float | None = None) -> str:
     touched at all.)
 
     Returns the live backend name ("tpu", "cpu", ...)."""
+    global _fallback
     if timeout is None:
         timeout = float(os.environ.get("ELEPHAS_BACKEND_TIMEOUT", "120"))
     want = (os.environ.get("JAX_PLATFORMS") or "").strip().lower()
@@ -123,11 +146,14 @@ def ensure_backend(timeout: float | None = None) -> str:
             jax.config.update("jax_platforms", want)
         except Exception as e:  # noqa: BLE001 — unknown platform string
             logger.warning("could not honor JAX_PLATFORMS=%s: %s", want, e)
-    name = _probe_backend(timeout)
+    why: list = []
+    name = _probe_backend(timeout, reason=why)
     if name is None:
+        reason = why[0] if why else "probe returned no backend"
         logger.warning(
-            "backend discovery failed/hung — falling back to the CPU "
-            "platform so this run still produces artifacts"
+            "backend discovery failed/hung (%s) — falling back to the "
+            "CPU platform so this run still produces artifacts",
+            reason,
         )
         # clear_backends needs jax's backend lock; run it under the
         # same deadline so a probe hung INSIDE backend creation (which
@@ -157,4 +183,11 @@ def ensure_backend(timeout: float | None = None) -> str:
             )
         jax.config.update("jax_platforms", "cpu")
         name = _probe_backend(timeout) or "cpu"
+        _fallback = {
+            "wanted": want or "auto",
+            "got": name,
+            "reason": reason,
+        }
+    else:
+        _fallback = None
     return name

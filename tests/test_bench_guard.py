@@ -330,6 +330,27 @@ class TestFaultPathLint:
             root, "elephas_tpu", "parallel", "pipeline_runner.py"
         ))
         assert os.path.exists(files[-1])
+        # ISSUE 16: bubble-fill threads chunked prefill through the
+        # decode ring — an eaten error mid-fill is a silently
+        # half-prefilled request decoding from garbage K/V; the
+        # scheduler's fill flagging and the prefix index's refcounts
+        # ride the same path (a swallowed error there double-frees a
+        # shared block). Pinned by name: scheduler/prefix_cache are in
+        # the serving glob, but the backend guard lives in utils/ and
+        # no glob covers it — it IS the fault path for a dead PJRT
+        # plugin (BENCH_r05), so a rename cannot drop it either.
+        assert any(
+            f.endswith(os.path.join("serving", "scheduler.py"))
+            for f in files
+        )
+        assert any(
+            f.endswith(os.path.join("serving", "prefix_cache.py"))
+            for f in files
+        )
+        files.append(os.path.join(
+            root, "elephas_tpu", "utils", "backend_guard.py"
+        ))
+        assert os.path.exists(files[-1])
         return root, files
 
     def test_no_bare_or_swallowed_excepts_on_fault_paths(self):
@@ -519,6 +540,18 @@ class TestTelemetryWallClockLint:
             f.endswith(os.path.join("serving", "pp_engine.py"))
             for f in files
         )
+        # ISSUE 16: bubble-fill admission (the fill flag) and the
+        # prefix index's match/commit decisions order a gang-
+        # replicated schedule — wall clock in either forks which
+        # requests fill vs prefill across processes; pinned by name
+        assert any(
+            f.endswith(os.path.join("serving", "scheduler.py"))
+            for f in files
+        )
+        assert any(
+            f.endswith(os.path.join("serving", "prefix_cache.py"))
+            for f in files
+        )
         assert len(files) > 9
         assert all(os.path.exists(f) for f in files), [
             f for f in files if not os.path.exists(f)
@@ -642,6 +675,18 @@ class TestTelemetryWallClockLint:
         # serving module; pinned by name so a rename cannot drop it
         assert any(
             f.endswith(os.path.join("serving", "pp_engine.py"))
+            for f in files
+        )
+        # ISSUE 16: bubble-fill telemetry (fill counters, fill_admit/
+        # fill_complete/fill_demote spans) and the prefix index's
+        # hit/miss counters record through captured attributes like
+        # every other serving emission site; pinned by name
+        assert any(
+            f.endswith(os.path.join("serving", "scheduler.py"))
+            for f in files
+        )
+        assert any(
+            f.endswith(os.path.join("serving", "prefix_cache.py"))
             for f in files
         )
         offences = []
@@ -796,3 +841,40 @@ class TestBackendGuard:
         t0 = _time.monotonic()
         assert backend_guard.ensure_backend(timeout=2) == "cpu"
         assert _time.monotonic() - t0 < 20
+
+    def test_fallback_is_recorded_for_the_artifact(self, monkeypatch):
+        """ISSUE 16 satellite: the BENCH_r05 crash mode is PJRT plugin
+        INIT dying (``make_c_api_client`` failed) inside the first
+        probe. Beyond surviving it, the guard must record
+        ``{wanted, got, reason}`` so bench.py can write a
+        ``backend_fallback`` field into every artifact — an rc=0
+        CPU-fallback run must be distinguishable from a healthy
+        accelerator run. A later healthy discovery resets the record
+        to None."""
+        import jax
+
+        from elephas_tpu.utils import backend_guard
+
+        calls = {"n": 0}
+        real = jax.default_backend
+
+        def plugin_init_dies():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError(
+                    "Unable to initialize backend 'tpu': "
+                    "make_c_api_client failed: INTERNAL"
+                )
+            return real()
+
+        monkeypatch.setattr(jax, "default_backend", plugin_init_dies)
+        assert backend_guard.ensure_backend(timeout=60) == "cpu"
+        rec = backend_guard.last_fallback()
+        assert rec is not None
+        assert rec["got"] == "cpu"
+        assert "make_c_api_client" in rec["reason"]
+        assert rec["wanted"]  # never empty: env platform or "auto"
+        # the probe succeeds from the second call on — a healthy
+        # discovery clears the record
+        assert backend_guard.ensure_backend(timeout=60) == "cpu"
+        assert backend_guard.last_fallback() is None

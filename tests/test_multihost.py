@@ -1250,6 +1250,92 @@ def test_two_process_pp_serving_engine(tmp_path):
     assert a["ring_decode_compiles"] == 1, a
 
 
+PP_FILL_SCRIPT = textwrap.dedent(
+    """
+    import json, hashlib
+    from elephas_tpu.parallel import distributed
+
+    assert distributed.initialize(), "gang init failed"
+    import jax
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    import numpy as np
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models import generate, transformer_lm
+    from elephas_tpu.serving import PPEngine
+
+    maxlen, vocab, n = 16, 8, 256
+    rng = np.random.default_rng(0)
+    starts = rng.integers(2, 6, size=n)
+    seq = (starts[:, None] + np.arange(maxlen + 1)) % 4 + 2
+    x, y = seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+
+    m = transformer_lm(vocab_size=vocab, maxlen=maxlen, d_model=32,
+                       num_heads=4, num_layers=2, dropout=0.0, lr=1e-2,
+                       seed=0)
+    SparkModel(m, num_workers=8).fit((x, y), epochs=3, batch_size=32)
+
+    # bubble-filling chunked prefill SPANNING the gang (ISSUE 16):
+    # one decode request saturates wave 0, then an 11-token prompt
+    # arrives mid-flight and prefills through wave 1's idle ticks —
+    # every fill chunk's ring hop crosses the process boundary. Both
+    # processes drive the identical schedule and must read tokens
+    # identical to the one-shot reference.
+    engine = PPEngine(m, num_stages=2, wave_slots=2, model_parallel=4,
+                      block_size=8, steps_per_wave=2, bubble_fill=True)
+    a = engine.submit([2, 3, 4], max_new_tokens=6)
+    engine.step()
+    late = engine.submit(
+        list((np.arange(11) % 4 + 2).astype(int)), max_new_tokens=4)
+    steps = 0
+    while engine.scheduler.has_work and steps < 80:
+        engine.step()
+        steps += 1
+    reqs = [a, late]
+    ok = all(
+        bool((np.asarray(r.full_sequence, np.int32) ==
+              generate(m, np.asarray(r.prompt, np.int32)[None],
+                       steps=r.max_new_tokens, kv_cache=True)[0]).all())
+        for r in reqs
+    )
+    cs = engine.compile_stats()
+    print("PPFILL " + json.dumps({
+        "process": jax.process_index(),
+        "match": ok,
+        "fill_tokens": int(engine.stats()["fill_tokens"]),
+        "ring_decode_compiles": cs["ring_decode_compiles"],
+        "digest": hashlib.sha256(b"".join(
+            np.ascontiguousarray(
+                np.asarray(r.full_sequence, np.int32)
+            ).tobytes() for r in reqs
+        )).hexdigest(),
+    }), flush=True)
+    """
+)
+
+
+def test_two_process_pp_bubble_fill(tmp_path):
+    """ISSUE 16 (bubble-fill tentpole): a mid-flight long-prompt
+    arrival bubble-fills through the PP ring's idle ticks while the
+    ring spans a 2-process gang — fill chunks hop the process boundary
+    on the same ppermute edge as decode — and both processes read
+    temp-0 tokens identical to the one-shot reference, from ONE
+    ring-decode compile, having actually filled (fill_tokens > 0)."""
+    rc, output = _run_gang(str(tmp_path), PP_FILL_SCRIPT)
+    assert rc == 0, output[-3000:]
+    results = [
+        json.loads(line.split("PPFILL ", 1)[1])
+        for line in output.splitlines()
+        if "PPFILL " in line
+    ]
+    assert len(results) == 2, output[-3000:]
+    a, b = sorted(results, key=lambda r: r["process"])
+    assert a["match"] and b["match"], (a, b)
+    assert a["digest"] == b["digest"], (a, b)
+    assert a["fill_tokens"] > 0 and b["fill_tokens"] > 0, (a, b)
+    assert a["ring_decode_compiles"] == 1, a
+
+
 def test_two_process_serving_engine(tmp_path):
     """ISSUE 1 (serving tentpole): the continuous-batching engine runs
     across a 2-process gang on the TP mesh — slot arena data-sharded

@@ -369,3 +369,197 @@ def test_pp_refresh_weights_reuploads(lm):
         assert engine.compile_stats() == before
     finally:
         lm.set_weights(orig)
+
+
+# -- bubble-filling chunked prefill + prefix sharing + cancel (ISSUE 16)
+
+
+def _drive_mid_flight(engine):
+    """One decode request saturates a wave, then an 11-token long
+    prompt arrives mid-flight; drain and return both requests."""
+    a = engine.submit([2, 3, 4], 10)
+    engine.step()  # a prefills + first decode window: one wave live
+    late = engine.submit(list((np.arange(11) % 4 + 2).astype(int)), 6)
+    steps = 0
+    while engine.scheduler.has_work:
+        engine.step()
+        steps += 1
+        assert steps < 80, "engine not live"
+    return a, late
+
+
+def test_pp_bubble_fill_mid_flight_token_exact(lm):
+    """A mid-flight long-prompt arrival prefills through the idle
+    wave's ring ticks (fill_tokens > 0) and stays token-exact vs both
+    the unfilled reference engine and one-shot generate; filling
+    changes WHEN tokens arrive, never WHAT they are — and the
+    cumulative pipeline occupancy strictly improves."""
+    from elephas_tpu.serving import PPEngine
+
+    kw = dict(
+        num_stages=2, wave_slots=2, block_size=8, steps_per_wave=2,
+    )
+    filled = PPEngine(lm, bubble_fill=True, **kw)
+    unfilled = PPEngine(lm, **kw)
+    fa, fb = _drive_mid_flight(filled)
+    ua, ub = _drive_mid_flight(unfilled)
+    st_f, st_u = filled.stats(), unfilled.stats()
+    assert st_f["fill_tokens"] > 0, "the filled arm never filled"
+    assert st_f["fill_rounds"] > 0
+    assert st_u["fill_tokens"] == 0, "bubble_fill=False must not fill"
+    _assert_exact(lm, [fa, fb, ua, ub])
+    assert fb.tokens == ub.tokens
+    assert fa.tokens == ua.tokens
+    # filling serves the prefill inside ticks the unfilled engine
+    # idles through (and skips its standalone prefill dispatch)
+    assert st_f["bubble_cumulative"] < st_u["bubble_cumulative"]
+    assert st_f["blocks_free"] == st_f["blocks_total"]
+
+
+def test_pp_bubble_fill_closed_compile_set(lm):
+    """The combined fill/decode ring program is part of the closed
+    set: a second identical mid-flight workload (which fills again)
+    compiles NOTHING."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=2, block_size=8,
+        steps_per_wave=2, bubble_fill=True,
+    )
+    a, b = _drive_mid_flight(engine)
+    _assert_exact(lm, [a, b])
+    first = engine.compile_stats()
+    assert first["bubble_fill"] is True
+    fills = engine.stats()["fill_rounds"]
+    assert fills > 0
+    a2, b2 = _drive_mid_flight(engine)
+    assert engine.compile_stats() == first
+    assert engine.stats()["fill_rounds"] > fills  # it DID fill again
+    assert b2.tokens == b.tokens
+
+
+def test_pp_cross_stage_prefix_hit_skips_chunks(lm):
+    """A shared-prefix admission reuses the cached blocks on EVERY
+    stage: reused_tokens reports the skip, the second request's table
+    splices the shared id in, and the shared block's K/V rows are
+    bitwise unchanged across the admission on all stages (no
+    re-prefill anywhere in the ring)."""
+    from elephas_tpu.serving import PPEngine
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=2, block_size=8,
+        steps_per_wave=2, prefix_cache=True, prefix_min_reuse=8,
+    )
+    shared = list((np.arange(9) % 4 + 2).astype(int))
+    r1 = engine.submit(shared + [3], 4)
+    engine.run()
+    pk1 = engine._host(engine._pk)
+    pv1 = engine._host(engine._pv)
+    r2 = engine.submit(shared + [4], 4)
+    engine.step()  # admit (prefix hit) + first window; r2 still live
+    sched = engine.scheduler
+    assert r2.reused_tokens == 8
+    assert r2.slot in sched.tables
+    shared_ids = sched.tables[r2.slot][:1]  # 8 tokens = 1 full block
+    pk2 = engine._host(engine._pk)
+    pv2 = engine._host(engine._pv)
+    for s in range(engine.num_stages):
+        for bid in shared_ids:
+            np.testing.assert_array_equal(
+                pk2[s][:, bid], pk1[s][:, bid],
+                err_msg=f"stage {s} re-wrote shared K block {bid}",
+            )
+            np.testing.assert_array_equal(
+                pv2[s][:, bid], pv1[s][:, bid],
+                err_msg=f"stage {s} re-wrote shared V block {bid}",
+            )
+    while sched.has_work:
+        engine.step()
+    _assert_exact(lm, [r1, r2])
+    assert engine.stats()["prefix_shared_tokens"] >= 8
+
+
+def test_pp_cancel_waiting_active_and_filler(lm):
+    """cancel(rid) parity with the flat engine: a waiting request
+    leaves the queue, an active one reclaims its wave slot at the
+    tick boundary, a mid-fill one abandons its chunked prefill —
+    all with ``req.error = RequestCancelled`` — and everything still
+    in flight stays token-exact with full block reclamation."""
+    from elephas_tpu.serving import PPEngine
+    from elephas_tpu.serving.engine import RequestCancelled
+
+    engine = PPEngine(
+        lm, num_stages=2, wave_slots=2, block_size=8,
+        steps_per_wave=2, bubble_fill=True,
+    )
+    # waiting: cancelled before any admission ever ran
+    w = engine.submit([2, 3], 6)
+    assert engine.cancel(w.rid) is True
+    assert w.done and isinstance(w.error, RequestCancelled)
+    # active: both waves decoding, then one slot reclaimed mid-flight
+    a = engine.submit([2, 3, 4], 12)
+    b = engine.submit([3, 4], 12)
+    engine.step()
+    assert engine.cancel(a.rid) is True
+    assert engine.cancel(a.rid) is False  # already finished
+    assert isinstance(a.error, RequestCancelled)
+    # filler: 20-token prompt needs 3 chunk rounds > k=2, so it is
+    # still mid-fill after one window — cancel abandons the fill
+    f = engine.submit(list((np.arange(20) % 4 + 2).astype(int)), 4)
+    engine.step()
+    assert f.slot in engine._filling  # genuinely cancelled MID-fill
+    assert engine.cancel(f.rid) is True
+    assert isinstance(f.error, RequestCancelled)
+    assert not engine._filling
+    engine.run()
+    assert b.done and b.error is None
+    _assert_exact(lm, [b])
+    assert engine.cancel(99999) is False  # unknown rid
+    st = engine.stats()
+    assert st["cancelled"] == 3
+    assert st["blocks_free"] == st["blocks_total"]
+
+
+def test_pp_gateway_cancel_route(lm):
+    """Satellite wiring: the gateway's ``POST /v1/requests/{rid}/cancel``
+    route calls the engine-generic ``cancel(rid)`` — attaching the PP
+    engine needs ZERO gateway changes. A queued request cancels over
+    HTTP while the gateway's driver thread is live; a second POST 404s
+    (already finished)."""
+    import http.client
+    import json
+
+    from elephas_tpu.serving import Gateway, PPEngine
+    from elephas_tpu.serving.engine import RequestCancelled
+
+    eng = PPEngine(
+        lm, num_stages=2, wave_slots=1, block_size=8,
+        steps_per_wave=2,
+    )
+    gw = Gateway(eng, port=0).start()
+    try:
+        # both slots busy with long budgets: b is deterministically
+        # WAITING when the cancel lands, whatever the driver's pace
+        a = eng.submit([2, 3, 4], 26)
+        c = eng.submit([3, 4, 5], 26)
+        b = eng.submit([4, 5], 4)
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", gw.port, timeout=30
+        )
+        conn.request("POST", f"/v1/requests/{b.rid}/cancel")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["cancelled"] is True
+        conn.close()
+        assert b.done and isinstance(b.error, RequestCancelled)
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", gw.port, timeout=30
+        )
+        conn.request("POST", f"/v1/requests/{b.rid}/cancel")
+        assert conn.getresponse().status == 404  # already done
+        conn.close()
+        assert a.error is None and c.error is None  # neighbors live
+    finally:
+        gw.stop()
+        gw.release_telemetry()
+        eng.release_telemetry()
