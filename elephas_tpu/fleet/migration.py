@@ -8,7 +8,7 @@ between replicas (``engine.export_request`` → wire →
 ``engine.import_request``) and resume **bit-exact at temperature 0**
 on a different engine serving identical weights.
 
-Layout (v1, little-endian)::
+Layout (v1 and v2, little-endian)::
 
     b"EMIG" | u16 version | u32 header_len | header JSON | array bytes
 
@@ -16,11 +16,23 @@ The header is the engine's export payload minus the arrays: request
 identity (rid, trace context), prompt + generated tokens,
 budget/sampling/tenant knobs, and the resume cursor state
 (``cur_len``, ``n_blocks``, ``block_size``) plus per-layer array specs
-(name, shape, dtype) in sorted-name order. The arrays follow as raw
-``tobytes()`` in that exact order (k then v per layer), so decoding is
-``frombuffer`` + ``reshape`` — a bitwise round-trip, no re-encoding,
-no quantization, and **no pickle** (the PR-2 wire-module rule: framed
-binary + JSON headers only).
+in sorted-name order. The arrays follow as raw ``tobytes()`` in that
+exact order, so decoding is ``frombuffer`` + ``reshape`` — a bitwise
+round-trip, no re-encoding, and **no pickle** (the PR-2 wire-module
+rule: framed binary + JSON headers only).
+
+**v2 (ISSUE 19, quantized KV)** generalizes the per-layer spec from a
+fixed fp ``(k, v)`` pair to an ``arrays`` LIST — a quantized engine's
+rows are 4-tuples ``(kq, vq, k_scale, v_scale)`` (int8 codes + f32
+scales), and the header gains ``kv_dtype`` so an importer can refuse
+a dtype its arena doesn't speak BEFORE touching bytes. Quantized rows
+cross the wire as their stored bytes — the whole point: the record is
+~4x (int8) / ~7x (int4) smaller than the fp equivalent, and the
+round-trip is still bitwise within the dtype. Encoding always emits
+v2; **legacy v1 fp records remain importable** (they decode to the
+same payload shape with ``kv_dtype="fp"``), and any other version is
+refused loudly — a torn or version-skewed migration must never resume
+as silent garbage.
 
 Cold records (``n_blocks == 0``) carry no arrays: the target replica
 re-prefills from the prompt — the right shape for requests that were
@@ -37,7 +49,7 @@ import numpy as np
 __all__ = ["MAGIC", "VERSION", "encode_record", "decode_record"]
 
 MAGIC = b"EMIG"
-VERSION = 1
+VERSION = 2
 
 _HEAD = struct.Struct("<HI")  # version, header length
 
@@ -56,23 +68,25 @@ def _np_dtype(name: str) -> np.dtype:
 def encode_record(record: dict) -> bytes:
     """Serialize one engine export payload (the dict
     :meth:`~elephas_tpu.serving.engine.InferenceEngine.export_request`
-    returns) into the v1 wire format."""
+    returns) into the v2 wire format. Per-layer rows may be any tuple
+    of arrays — fp ``(k, v)`` pairs or quantized ``(kq, vq, k_scale,
+    v_scale)`` 4-tuples — and travel at their STORED dtype."""
     rows = record.get("rows") or {}
     layers = []
     blobs: list[bytes] = []
     for name in sorted(rows):
-        k, v = rows[name]
-        k = np.ascontiguousarray(k)
-        v = np.ascontiguousarray(v)
+        arrs = [np.ascontiguousarray(a) for a in rows[name]]
         layers.append({
             "name": str(name),
-            "k_shape": list(k.shape), "k_dtype": k.dtype.name,
-            "v_shape": list(v.shape), "v_dtype": v.dtype.name,
+            "arrays": [
+                {"shape": list(a.shape), "dtype": a.dtype.name}
+                for a in arrs
+            ],
         })
-        blobs.append(k.tobytes())
-        blobs.append(v.tobytes())
+        blobs.extend(a.tobytes() for a in arrs)
     header = {key: val for key, val in record.items() if key != "rows"}
     header["version"] = VERSION
+    header.setdefault("kv_dtype", "fp")
     header["layers"] = layers
     hb = json.dumps(header).encode("utf-8")
     out = bytearray(MAGIC)
@@ -83,21 +97,35 @@ def encode_record(record: dict) -> bytes:
     return bytes(out)
 
 
+def _layer_array_specs(version: int, spec: dict) -> list[dict]:
+    """Normalize one layer's array specs across frame versions: v1's
+    fixed ``k_shape``/``v_shape`` pair becomes the v2 ``arrays`` list,
+    so one decode loop serves both."""
+    if version == 1:
+        return [
+            {"shape": spec["k_shape"], "dtype": spec["k_dtype"]},
+            {"shape": spec["v_shape"], "dtype": spec["v_dtype"]},
+        ]
+    return list(spec["arrays"])
+
+
 def decode_record(data) -> dict:
-    """Parse v1 wire bytes back into the engine's import payload
-    shape. Raises ``ValueError`` loudly on a bad magic, unknown
-    version, or truncated/oversized array section — a torn migration
-    must never resume as silent garbage."""
+    """Parse wire bytes (v2, or legacy v1 fp) back into the engine's
+    import payload shape. Raises ``ValueError`` loudly on a bad magic,
+    unknown version, or truncated/oversized array section — a torn
+    migration must never resume as silent garbage. v1 records come
+    back with ``kv_dtype="fp"`` so the importer's dtype check applies
+    uniformly."""
     mv = memoryview(data)
     if len(mv) < 4 + _HEAD.size or bytes(mv[:4]) != MAGIC:
         raise ValueError(
             "not a migration record (bad magic — expected EMIG)"
         )
     version, hlen = _HEAD.unpack_from(mv, 4)
-    if version != VERSION:
+    if version not in (1, VERSION):
         raise ValueError(
             f"migration record version {version} unsupported (this "
-            f"codec speaks v{VERSION})"
+            f"codec speaks v1..v{VERSION})"
         )
     off = 4 + _HEAD.size
     if off + hlen > len(mv):
@@ -109,31 +137,29 @@ def decode_record(data) -> dict:
     off += hlen
     rows = {}
     for spec in header.pop("layers", []):
-        kd = _np_dtype(spec["k_dtype"])
-        vd = _np_dtype(spec["v_dtype"])
-        k_shape = tuple(int(s) for s in spec["k_shape"])
-        v_shape = tuple(int(s) for s in spec["v_shape"])
-        k_count = int(np.prod(k_shape, dtype=np.int64))
-        v_count = int(np.prod(v_shape, dtype=np.int64))
-        need = k_count * kd.itemsize + v_count * vd.itemsize
-        if off + need > len(mv):
-            raise ValueError(
-                f"truncated migration record: layer "
-                f"{spec['name']!r} needs {need} more bytes"
+        arrs = []
+        for aspec in _layer_array_specs(version, spec):
+            dt = _np_dtype(aspec["dtype"])
+            shape = tuple(int(s) for s in aspec["shape"])
+            count = int(np.prod(shape, dtype=np.int64))
+            need = count * dt.itemsize
+            if off + need > len(mv):
+                raise ValueError(
+                    f"truncated migration record: layer "
+                    f"{spec['name']!r} needs {need} more bytes"
+                )
+            arrs.append(
+                np.frombuffer(
+                    mv, dtype=dt, count=count, offset=off
+                ).reshape(shape)
             )
-        k = np.frombuffer(
-            mv, dtype=kd, count=k_count, offset=off
-        ).reshape(k_shape)
-        off += k_count * kd.itemsize
-        v = np.frombuffer(
-            mv, dtype=vd, count=v_count, offset=off
-        ).reshape(v_shape)
-        off += v_count * vd.itemsize
-        rows[spec["name"]] = (k, v)
+            off += need
+        rows[spec["name"]] = tuple(arrs)
     if off != len(mv):
         raise ValueError(
             f"migration record carries {len(mv) - off} trailing "
             f"bytes — torn write or mismatched header"
         )
+    header.setdefault("kv_dtype", "fp")
     header["rows"] = rows
     return header

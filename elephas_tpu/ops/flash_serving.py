@@ -91,7 +91,8 @@ def _online_update(m, l, acc, s, vt):
 
 
 def flash_span_chunk(q, gk, gv, pos_mat, scale=None,
-                     block_k: int = DEFAULT_BLOCK):
+                     block_k: int = DEFAULT_BLOCK,
+                     kv_dtype: str = "fp", kv_scales=None):
     """Tiled attention of chunk queries over a resident K/V span.
 
     ``q``: ``[B, H, C, Dh]`` queries at absolute positions ``pos_mat``
@@ -106,8 +107,16 @@ def flash_span_chunk(q, gk, gv, pos_mat, scale=None,
     bounded by the span ladder); ragged final tiles take their natural
     smaller static shape — no padding pass. Peak intermediate is one
     ``[B, H, C, block_k]`` tile instead of the naive ``[B, H, C, S]``.
+
+    Quantized spans (ISSUE 19): with ``kv_dtype`` ``"int8"``/``"int4"``
+    the span arrives as int8 codes ``[B, S, H, Dhp]`` plus per-(pos,
+    head) f32 ``kv_scales = (k_scales, v_scales)`` (``[B, S, H]``
+    each), and each K/V tile dequantizes HERE — the tile loop is the
+    seam, so fp rows never exist beyond one ``block_k`` tile.
     """
     import jax.numpy as jnp
+
+    from elephas_tpu.serving.kv_quant import dequantize_rows
 
     f32 = jnp.float32
     B, H, C, Dh = q.shape
@@ -120,8 +129,17 @@ def flash_span_chunk(q, gk, gv, pos_mat, scale=None,
     acc = jnp.zeros((B, H, C, Dh), f32)
     for j0 in range(0, S, block_k):
         j1 = min(S, j0 + block_k)
-        kt = gk[:, j0:j1].astype(f32)  # [B, bk, H, Dh]
-        vt = gv[:, j0:j1].astype(f32)
+        if kv_dtype == "fp":
+            kt = gk[:, j0:j1].astype(f32)  # [B, bk, H, Dh]
+            vt = gv[:, j0:j1].astype(f32)
+        else:
+            ks, vs = kv_scales
+            kt = dequantize_rows(
+                gk[:, j0:j1], ks[:, j0:j1], kv_dtype, Dh
+            )
+            vt = dequantize_rows(
+                gv[:, j0:j1], vs[:, j0:j1], kv_dtype, Dh
+            )
         s = jnp.einsum("bhcd,bkhd->bhck", q, kt) * scale
         vis = (
             jnp.arange(j0, j1)[None, None, None, :]
@@ -133,16 +151,18 @@ def flash_span_chunk(q, gk, gv, pos_mat, scale=None,
 
 
 def flash_span_decode(q, gk, gv, positions, scale=None,
-                      block_k: int = DEFAULT_BLOCK):
+                      block_k: int = DEFAULT_BLOCK,
+                      kv_dtype: str = "fp", kv_scales=None):
     """One-row decode attention over a K/V span: ``q`` ``[B, H, Dh]``
     at per-slot ``positions`` ``[B]``, ``gk``/``gv`` ``[B, S, H, Dh]``.
     Returns ``[B, H, Dh]`` float32. The single query row rides
     :func:`flash_span_chunk` with ``C == 1`` — one attention variant
     to keep correct, and the block-span read (``S`` = a span/table
-    bucket, not ``maxlen``) is where decode's win lives."""
+    bucket, not ``maxlen``) is where decode's win lives. Quantized
+    spans pass ``kv_dtype``/``kv_scales`` through to the tile loop."""
     out = flash_span_chunk(
         q[:, :, None], gk, gv, positions[:, None], scale=scale,
-        block_k=block_k,
+        block_k=block_k, kv_dtype=kv_dtype, kv_scales=kv_scales,
     )
     return out[:, :, 0]
 

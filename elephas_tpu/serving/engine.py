@@ -42,6 +42,10 @@ import numpy as np
 from elephas_tpu import telemetry
 from elephas_tpu.ops.flash_serving import span_bucket_for, span_buckets
 from elephas_tpu.serving.blocks import BlockAllocator
+from elephas_tpu.serving.kv_quant import (
+    check_kv_dtype,
+    quantize_rows_np,
+)
 from elephas_tpu.serving.kv_cache import (
     SlotKVCache,
     chunked_prefill_forward,
@@ -88,8 +92,12 @@ class RequestCancelled(RuntimeError):
 
 class _OffloadRecord:
     """Host-side K/V of a preempted request: dense block rows per
-    layer (``{name: (k, v)}``, each ``[n_blocks, block_size, H, Dh]``
-    numpy) plus the cursor state needed for a bit-exact resume."""
+    layer plus the cursor state needed for a bit-exact resume. Rows
+    are tuples of numpy arrays at the arena's STORED dtype — fp
+    ``(k, v)`` pairs, or quantized ``(kq, vq, k_scale, v_scale)``
+    4-tuples (ISSUE 19: offloaded blocks stay quantized on host, so
+    the record is ~4x/~7x smaller and the resume round-trip is
+    bitwise within the dtype)."""
 
     __slots__ = ("rows", "n_blocks", "cur_len")
 
@@ -100,7 +108,7 @@ class _OffloadRecord:
 
     def nbytes(self) -> int:
         return sum(
-            k.nbytes + v.nbytes for k, v in self.rows.values()
+            a.nbytes for leaves in self.rows.values() for a in leaves
         )
 
 
@@ -231,6 +239,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                  block_size: int | None = None,
                  num_blocks: int | None = None,
                  preemption: bool = False,
+                 kv_dtype: str = "fp",
                  speculative: bool = False,
                  spec_k: int | None = None,
                  spec_drafter=None,
@@ -365,6 +374,24 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             self._tbuckets = table_buckets(self.max_blocks_per_slot)
         self.preemption = bool(preemption)
 
+        # -- quantized paged KV (ISSUE 19) -----------------------------
+        # "fp" (default) stores f32 pool blocks — the parity oracle,
+        # bit-for-bit the historical engine. "int8"/"int4" store
+        # quantized codes + per-(position, head) f32 scales: quantize
+        # on write inside the paged programs, dequantize inside the
+        # flash span tiles (kv_quant module). Temp-0 exactness holds
+        # WITHIN a dtype (offload/resume/migration move quantized
+        # blocks bit-identically); cross-dtype quality is gated
+        # against the fp oracle (docs/API.md "Quantized KV").
+        check_kv_dtype(kv_dtype)
+        if kv_dtype != "fp" and not self.paged:
+            raise ValueError(
+                "kv_dtype requires paged=True — the fixed slot arena "
+                "has no quantized storage path; silently serving fp "
+                "would misreport the KV byte budget"
+            )
+        self.kv_dtype = kv_dtype
+
         # -- speculative decoding knobs (ISSUE 8) ----------------------
         self.speculative = bool(speculative)
         if not self.speculative:
@@ -493,7 +520,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             self.arena = PagedKVPool(
                 flash_layers, self.num_blocks, self.block_size,
                 mesh=mesh, batch_axes=self.batch_axes,
-                model_axis=model_axis,
+                model_axis=model_axis, kv_dtype=self.kv_dtype,
             )
         else:
             self.arena = SlotKVCache(
@@ -683,6 +710,27 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "elephas_serving_migrated_in_total",
             "Requests adopted from another replica's migration record",
         )
+        # quantized KV + scoring (ISSUE 19): counters exist in EVERY
+        # mode (stats() keys never vary by config) — fp engines count
+        # fp-sized offload/export bytes, non-scoring callers simply
+        # never increment score requests
+        self._m_offload_bytes = _c(
+            "elephas_serving_kv_quant_offload_bytes_total",
+            "Host bytes written by preemption offload records (KV "
+            "block rows + scales at the arena's stored kv_dtype)",
+        )
+        self._m_export_bytes = _c(
+            "elephas_serving_kv_quant_export_bytes_total",
+            "Payload bytes of migration/handoff export records "
+            "(per-layer arrays at the stored kv_dtype, header "
+            "excluded) — the counted wire-size the bench quant "
+            "section gates on",
+        )
+        self._m_score_requests = _c(
+            "elephas_serving_score_requests_total",
+            "Completions scored through score() / POST /v1/score "
+            "(one verify-style forward each, engine state untouched)",
+        )
 
         def _tc(name, help_):
             return treg.counter(name, help_, labels=("engine", "tenant"))
@@ -742,6 +790,14 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "constant 1, kernel name in the label)",
             labels=("engine", "kernel"),
         ).labels(engine=eid, kernel=self.attention).set(1)
+        # kv_dtype info gauge (ISSUE 19): same join-by-label idiom as
+        # the kernel gauge — which storage dtype this arena speaks
+        treg.gauge(
+            "elephas_serving_kv_quant_mode",
+            "KV storage dtype of the paged arena (info gauge: "
+            "constant 1, dtype name in the label)",
+            labels=("engine", "kv_dtype"),
+        ).labels(engine=eid, kv_dtype=self.kv_dtype).set(1)
         # per-bucket prefill-token histogram (ISSUE 11): one observation
         # per completed prefill, labeled by the compiled bucket it ran
         # through — Chrome traces say WHERE long prompts spend TTFT,
@@ -761,7 +817,8 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         ).labels(engine=eid).set(self.num_slots)
         treg.gauge(
             "elephas_serving_kv_arena_bytes",
-            "Host-side size estimate of the full (f32) KV arena",
+            "Host-side size estimate of the full KV arena at its "
+            "stored dtype (f32, or int8/int4 codes + scales)",
             labels=("engine",),
         ).labels(engine=eid).set(self.arena.nbytes())
         if self.paged:
@@ -779,13 +836,14 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         maxlen, arena = self.maxlen, self.arena
 
         def _constrain_all(caches):
+            # leaf-generic over the entry arity: fp (k, v) pairs and
+            # quantized (kq, vq, k_scale, v_scale) 4-tuples alike
             heads = {name: h for name, h, _d in arena.specs}
             return {
-                name: (
-                    arena.constrain(k, heads[name]),
-                    arena.constrain(v, heads[name]),
+                name: tuple(
+                    arena.constrain(z, heads[name]) for z in leaves
                 )
-                for name, (k, v) in caches.items()
+                for name, leaves in caches.items()
             }
 
         def _vec(z):
@@ -947,6 +1005,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                     model, w, last, positions, caches, tables,
                     self.block_size, maxlen, active,
                     local=mesh is None, attention=attn_kernel,
+                    kv_dtype=self.kv_dtype,
                 )
                 caches = _constrain_all(caches)
                 key, sub = jax.random.split(key)
@@ -976,7 +1035,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             logits, caches = paged_chunk_forward(
                 model, w, tokens, caches, tables, offs, clens, act,
                 self.block_size, maxlen, local=mesh is None,
-                attention=attn_kernel,
+                attention=attn_kernel, kv_dtype=self.kv_dtype,
             )
             caches = _constrain_all(caches)
             C = tokens.shape[1]
@@ -1058,11 +1117,48 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             logits, caches = paged_verify_forward(
                 model, w, tokens, caches, tables, offs, n_fed, act,
                 self.block_size, maxlen, local=mesh is None,
-                attention=attn_kernel,
+                attention=attn_kernel, kv_dtype=self.kv_dtype,
             )
             caches = _constrain_all(caches)
             key, sampled = _sample_window(logits, temps, key)
             return caches, key, sampled
+
+        # -- completion scoring (ISSUE 19): verify-WITHOUT-accept. One
+        # chunk/verify-shaped forward feeds prompt+completion[:-1] on
+        # lane 0 of a caches pytree that is NOT donated and whose
+        # updated copy is DISCARDED — the live arena never changes, so
+        # scoring composes with in-flight serving. Paged mode scores
+        # through a scratch arange block table (the one-hot writes land
+        # in the discarded copy only); row j of the logits scores the
+        # token at absolute position j+1, which is exactly the
+        # completion logprob/greedy-token oracle the quant bench gates
+        # consume. Compiled per (width bucket[, table bucket / span])
+        # — the same closed ladders the serving programs use.
+        def paged_score(w, caches, tables, tokens, clens, act, targets):
+            offs = jnp.zeros((self.num_slots,), jnp.int32)
+            logits, _ = paged_chunk_forward(
+                model, w, tokens, caches, tables, offs, clens, act,
+                self.block_size, maxlen, local=mesh is None,
+                attention=attn_kernel, kv_dtype=self.kv_dtype,
+            )
+            row = logits[0]  # [C, vocab] — the scoring lane
+            lp = jax.nn.log_softmax(row, axis=-1)
+            tlp = jnp.take_along_axis(lp, targets[:, None], axis=-1)
+            greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            return tlp[:, 0], greedy
+
+        def fixed_score(w, caches, tokens, clens, act, targets,
+                        span=None):
+            offs = jnp.zeros((self.num_slots,), jnp.int32)
+            logits, _ = verify_forward(
+                model, w, tokens, caches, offs, clens, act, maxlen,
+                attention=attn_kernel, span=span,
+            )
+            row = logits[0]
+            lp = jax.nn.log_softmax(row, axis=-1)
+            tlp = jnp.take_along_axis(lp, targets[:, None], axis=-1)
+            greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            return tlp[:, 0], greedy
 
         # -- SP long-prompt prefill program (ISSUE 11): one whole-
         # prompt forward over the SP mesh returning logits AND every
@@ -1141,6 +1237,10 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 jax.jit(paged_spec_verify, donate_argnums=(1, 5))
                 if self.speculative else None
             )  # args: w, caches, tables, packed, temps, key
+            self._score_jit = jax.jit(paged_score)
+            # args: w, caches, tables, tokens, clens, act, targets —
+            # NOTHING donated: the updated caches are discarded, the
+            # live arena survives untouched
         else:
             self._prefill_jit = jax.jit(
                 prefill, donate_argnums=(1, 2, 3, 4, 9)
@@ -1167,6 +1267,10 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 )
                 if self.speculative else None
             )  # args: w, caches, packed, temps, key, span (static)
+            self._score_jit = jax.jit(
+                fixed_score, static_argnums=(6,)
+            )  # args: w, caches, tokens, clens, act, targets, span
+            #   (static) — nothing donated, updated caches discarded
 
         self.refresh_weights()
         self._caches, self._lengths, self._last, self._temps = (
@@ -2039,18 +2143,20 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             )
             n = len(pre.blocks)
             host = {
-                name: (
-                    np.asarray(self._host(k))[:n].copy(),
-                    np.asarray(self._host(v))[:n].copy(),
+                name: tuple(
+                    np.asarray(self._host(z))[:n].copy()
+                    for z in leaves
                 )
-                for name, (k, v) in rows.items()
+                for name, leaves in rows.items()
             }
-            self._offloaded[req.rid] = _OffloadRecord(
+            store = _OffloadRecord(
                 rows=host, n_blocks=n, cur_len=pre.cur_len,
             )
+            self._offloaded[req.rid] = store
         self._set_active(pre.slot, False)
         self._m_preemptions.inc()
         self._m_offload_blocks.inc(n)
+        self._m_offload_bytes.inc(store.nbytes())
         logger.info(
             "preempted request %d (priority %d): %d blocks offloaded "
             "to host, slot %d freed", req.rid, req.priority, n, pre.slot,
@@ -2078,11 +2184,13 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             ids = self._pad_ids(adm.blocks[:n])
             Tb = len(ids)
             rows = {}
-            for name, (hk, hv) in store.rows.items():
-                pk = np.zeros((Tb,) + hk.shape[1:], hk.dtype)
-                pv = np.zeros((Tb,) + hv.shape[1:], hv.dtype)
-                pk[:n], pv[:n] = hk, hv
-                rows[name] = (self._stage(pk), self._stage(pv))
+            for name, leaves in store.rows.items():
+                staged = []
+                for hz in leaves:
+                    pz = np.zeros((Tb,) + hz.shape[1:], hz.dtype)
+                    pz[:n] = hz
+                    staged.append(self._stage(pz))
+                rows[name] = tuple(staged)
             self._caches = self._dispatch(
                 "resume_scatter", self._scatter_jit,
                 self._caches, self._stage(ids), rows,
@@ -2209,10 +2317,21 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 # sentinel-padded ids drop the bucketed tail; garbage
                 # rows past the prompt land inside the request's OWN
                 # reservation, where rewrite-before-visible covers them
-                rows[name] = (
-                    self._stage(hk.reshape(Tb, bs, *hk.shape[1:])),
-                    self._stage(hv.reshape(Tb, bs, *hv.shape[1:])),
-                )
+                hk = hk.reshape(Tb, bs, *hk.shape[1:])
+                hv = hv.reshape(Tb, bs, *hv.shape[1:])
+                if self.kv_dtype == "fp":
+                    rows[name] = (self._stage(hk), self._stage(hv))
+                else:
+                    # quantized arena: the landing rows must be codes
+                    # + scales (the pool's stored layout) — host-side
+                    # quantization matches the device programs'
+                    # write-path math
+                    hk, hks = quantize_rows_np(hk, self.kv_dtype)
+                    hv, hvs = quantize_rows_np(hv, self.kv_dtype)
+                    rows[name] = (
+                        self._stage(hk), self._stage(hv),
+                        self._stage(hks), self._stage(hvs),
+                    )
             self._caches = self._dispatch(
                 "resume_scatter", self._scatter_jit,
                 self._caches, self._stage(ids), rows,
@@ -2772,6 +2891,101 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         self._evict_finished()
         return True
 
+    def score(self, prompt, completion) -> dict:
+        """Log-probabilities of ``completion`` given ``prompt`` in ONE
+        forward pass (ISSUE 19): scoring is verify-without-accept —
+        the sequence ``prompt + completion[:-1]`` feeds through the
+        existing verify/chunk program shape on lane 0, and logits row
+        ``j`` scores the token at position ``j+1``. The forward runs
+        against a NON-donated copy of the live arena whose update is
+        discarded, so scoring never perturbs in-flight serving state
+        (no allocation, no cursor movement, no PRNG consumption).
+
+        Returns ``{"logprobs": [per-completion-token logprob],
+        "total_logprob", "greedy_tokens": [argmax token per position],
+        "agreement": fraction of completion tokens matching greedy}``
+        — greedy tokens make this the fp-oracle token-agreement probe
+        the quant bench gates consume (temperature-0 caveat: agreement
+        compares argmax, so it is exactly what greedy decode would
+        emit position-by-position given this prefix).
+
+        Compiled per (width bucket[, table/span bucket]) — the same
+        closed ladders the serving programs use, so a scoring workload
+        cannot grow the compile set unboundedly. Requires ``prompt``
+        and ``completion`` non-empty and their sum within ``maxlen``.
+        """
+        prompt = [int(t) for t in prompt]
+        completion = [int(t) for t in completion]
+        if not prompt:
+            raise ValueError("score() needs a non-empty prompt")
+        if not completion:
+            raise ValueError("score() needs a non-empty completion")
+        total = len(prompt) + len(completion)
+        if total > self.maxlen:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + completion "
+                f"({len(completion)}) exceeds maxlen ({self.maxlen})"
+            )
+        seq = prompt + completion
+        n = total - 1  # fed positions; row j scores seq[j+1]
+        width = self.scheduler.bucket_for(n)
+        tokens = np.zeros((self.num_slots, width), np.int32)
+        tokens[0, :n] = seq[:n]
+        targets = np.zeros((width,), np.int32)
+        targets[:n] = seq[1:]
+        clens = np.zeros((self.num_slots,), np.int32)
+        clens[0] = n
+        act = np.zeros((self.num_slots,), bool)
+        act[0] = True
+        if self.paged:
+            nb = blocks_for(n, self.block_size)
+            if nb > self.num_blocks:
+                raise ValueError(
+                    f"scoring {n} positions needs {nb} blocks — more "
+                    f"than the pool's {self.num_blocks}"
+                )
+            Tb = table_bucket_for(nb, self._tbuckets)
+            # scratch arange table: the one-hot writes land only in
+            # the DISCARDED pool copy, so any block ids are safe
+            tab = np.full((self.num_slots, Tb), self.num_blocks,
+                          np.int32)
+            tab[0, :nb] = np.arange(nb, dtype=np.int32)
+            tlp, greedy = self._dispatch(
+                "score", self._score_jit,
+                self._weights, self._caches, self._stage(tab),
+                self._stage(tokens), self._stage_slots(clens),
+                self._stage_slots(act), self._stage(targets),
+            )
+        else:
+            span = (
+                span_bucket_for(n, self._sbuckets)
+                if self.attention == "flash" else None
+            )
+            tlp, greedy = self._dispatch(
+                "score", self._score_jit,
+                self._weights, self._caches, self._stage(tokens),
+                self._stage_slots(clens), self._stage_slots(act),
+                self._stage(targets), span,
+            )
+        tlp = np.asarray(self._host(tlp))
+        greedy = np.asarray(self._host(greedy))
+        p = len(prompt)
+        lps = [float(x) for x in tlp[p - 1:n]]
+        g = [int(t) for t in greedy[p - 1:n]]
+        agreed = sum(1 for a, b in zip(g, completion) if a == b)
+        self._m_score_requests.inc()
+        self._tracer.emit(
+            "serve.score", prompt_tokens=p,
+            completion_tokens=len(completion),
+            agreement=agreed / len(completion),
+        )
+        return {
+            "logprobs": lps,
+            "total_logprob": float(sum(lps)),
+            "greedy_tokens": g,
+            "agreement": agreed / len(completion),
+        }
+
     def export_request(self, rid: int, *,
                        notify_stream: bool = False) -> dict:
         """Freeze one live request and hand back its **migration
@@ -2853,13 +3067,19 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         if notify_stream:
             self._notify_stream_end(req)
         self._m_migrated_out.inc()
+        self._m_export_bytes.inc(0 if store is None else store.nbytes())
         self._tracer.emit(
             "serve.export", rid=req.rid, warm=store is not None,
             n_blocks=0 if store is None else store.n_blocks,
             tokens=len(req.tokens), step=self.scheduler._steps,
         )
         return {
-            "version": 1,
+            # v2 (ISSUE 19): rows travel at the arena's STORED dtype
+            # (fp pairs, or quantized code+scale 4-tuples), declared
+            # by kv_dtype so an importer can refuse a mismatch before
+            # touching array bytes; v1 records remain importable
+            "version": 2,
+            "kv_dtype": self.kv_dtype,
             "rid": int(req.rid),
             "prompt": [int(t) for t in req.prompt],
             "tokens": [int(t) for t in req.tokens],
@@ -2896,12 +3116,16 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
 
         Validates loudly: version, maxlen fit, rid not already live
         here, tenant known to this engine's policy, and — warm —
-        paged target, matching block size/geometry, and the
-        ``cur_len == prompt + generated - 1`` resume invariant."""
-        if int(record.get("version", -1)) != 1:
+        paged target, matching block size/geometry, matching
+        ``kv_dtype`` (quantized blocks are only bit-portable between
+        arenas storing the same dtype — v1/fp records refuse into a
+        quantized arena and vice versa), and the ``cur_len == prompt
+        + generated - 1`` resume invariant."""
+        if int(record.get("version", -1)) not in (1, 2):
             raise ValueError(
                 f"unknown migration record version "
-                f"{record.get('version')!r} (this engine speaks v1)"
+                f"{record.get('version')!r} (this engine speaks "
+                f"v1..v2)"
             )
         sched = self.scheduler
         rid = int(record["rid"])
@@ -2964,6 +3188,25 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                     f"engine's {self.block_size} — K/V blocks are not "
                     f"geometry-portable"
                 )
+            rec_dtype = record.get("kv_dtype", "fp")
+            if rec_dtype != self.kv_dtype:
+                raise ValueError(
+                    f"record kv_dtype {rec_dtype!r} != this engine's "
+                    f"{self.kv_dtype!r} — quantized KV blocks are "
+                    f"bit-portable only between arenas storing the "
+                    f"same dtype (re-drive the request cold instead)"
+                )
+            arity = 2 if self.kv_dtype == "fp" else 4
+            bad_arity = {
+                name: len(leaves) for name, leaves in rows.items()
+                if len(leaves) != arity
+            }
+            if bad_arity:
+                raise ValueError(
+                    f"record rows carry {bad_arity} arrays per layer "
+                    f"— a {self.kv_dtype!r} arena stores {arity} "
+                    f"(torn or mis-encoded record)"
+                )
             if not tokens:
                 raise ValueError(
                     "warm record without generated tokens — the resume "
@@ -3021,10 +3264,10 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             rec["submit_seq"] = seq
         if warm:
             host_rows = {
-                name: (
-                    np.ascontiguousarray(k), np.ascontiguousarray(v)
+                name: tuple(
+                    np.ascontiguousarray(a) for a in leaves
                 )
-                for name, (k, v) in rows.items()
+                for name, leaves in rows.items()
             }
             self._offloaded[rid] = _OffloadRecord(
                 rows=host_rows, n_blocks=n_blocks,
@@ -3167,11 +3410,18 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                     if self.paged else None
                 ),
             }
+        from elephas_tpu.utils import backend_guard
+
         out = {
             "engine": self.telemetry_label,
             "steps": sched._steps,
             "num_slots": self.num_slots,
             "attention": self.attention,
+            "kv_dtype": self.kv_dtype,
+            # the BENCH_r05 lesson at the serving surface: if backend
+            # discovery fell back to CPU, say so HERE, not only in
+            # bench JSON
+            "backend_fallback": backend_guard.last_fallback(),
             "slots": slots,
             "waiting": sched.queue_snapshot(),
             "queued_tokens": sched.queued_tokens,
@@ -3243,6 +3493,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 "sp_prefill_compiles": (
                     n(self._sp_jit) if self._sp_jit is not None else 0
                 ),
+                "score_compiles": n(self._score_jit),
                 "buckets": tuple(self.scheduler.buckets),
                 "table_buckets": tuple(self._tbuckets),
                 "prefill_chunk": self.prefill_chunk,
@@ -3250,6 +3501,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                 "num_blocks": self.num_blocks,
                 "spec_k": self.spec_k,
                 "attention": self.attention,
+                "kv_dtype": self.kv_dtype,
             }
         return {
             "decode_compiles": n(self._decode_jit),
@@ -3259,6 +3511,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "verify_compiles": (
                 n(self._verify_jit) if self.speculative else 0
             ),
+            "score_compiles": n(self._score_jit),
             "buckets": tuple(self.scheduler.buckets),
             # flash block-span reads compile per touched span bucket
             # (closed ladder); naive never leaves the maxlen span, so
@@ -3267,6 +3520,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "prefill_chunk": self.prefill_chunk,
             "spec_k": self.spec_k,
             "attention": self.attention,
+            "kv_dtype": self.kv_dtype,
         }
 
     def _tenant_stats(self) -> dict:
@@ -3383,6 +3637,13 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "cancelled": int(self._m_cancelled.value),
             "migrated_out": int(self._m_migrated_out.value),
             "migrated_in": int(self._m_migrated_in.value),
+            # quantized KV (ISSUE 19): the stored dtype plus the
+            # counted wire/offload byte totals the bench's compression
+            # gate reads — registry-backed, one store, two views
+            "kv_dtype": self.kv_dtype,
+            "kv_quant_offload_bytes": int(self._m_offload_bytes.value),
+            "kv_quant_export_bytes": int(self._m_export_bytes.value),
+            "score_requests": int(self._m_score_requests.value),
         }
         if self.policy is not None:
             out["policy"] = self.policy.stats()

@@ -579,8 +579,9 @@ class Gateway:
             return "POST /v1/requests/:rid/export"
         route = f"{method} {bare}"
         if route in (
-            "POST /v1/generate", "GET /metrics", "GET /stats",
-            "GET /healthz", "GET /debug/engine", "POST /v1/migrate",
+            "POST /v1/generate", "POST /v1/score", "GET /metrics",
+            "GET /stats", "GET /healthz", "GET /debug/engine",
+            "POST /v1/migrate",
         ):
             return route
         return "other"
@@ -716,7 +717,55 @@ class Gateway:
             if method != "POST":
                 raise _HttpError(405, "POST only")
             return await self._migrate(body, writer, conn)
+        if path == "/v1/score":
+            if method != "POST":
+                raise _HttpError(405, "POST only")
+            return await self._score(body, writer, conn)
         raise _HttpError(404, f"no route {path}")
+
+    async def _score(self, body: bytes, writer, conn) -> int:
+        """``POST /v1/score`` — log-probabilities of a given completion
+        under the served model in ONE forward pass (ISSUE 19): body is
+        ``{"prompt": [tokens], "completion": [tokens]}``, response
+        carries per-token logprobs, their sum, the greedy (argmax)
+        token at each position, and the completion-vs-greedy agreement
+        fraction. This is the quality oracle the quant bench gates
+        consume: score the same completion on an fp and a quantized
+        engine and compare. Scoring never perturbs in-flight serving
+        state (the engine forward is discard-after-read), but it DOES
+        take the engine lock for its forward, like any submit."""
+        try:
+            spec = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise _HttpError(400, f"bad JSON body: {e}")
+        if not isinstance(spec, dict):
+            raise _HttpError(400, "body must be a JSON object")
+        unknown = set(spec) - {"prompt", "completion"}
+        if unknown:
+            raise _HttpError(400, f"unknown fields {sorted(unknown)}")
+        for key in ("prompt", "completion"):
+            if not isinstance(spec.get(key), list):
+                raise _HttpError(
+                    400, f"{key} must be a list of token ids"
+                )
+        loop = asyncio.get_running_loop()
+
+        def do_score():
+            with self._engine_lock:
+                if self._stopping.is_set():
+                    raise _HttpError(503, "gateway is stopping")
+                return self.engine.score(
+                    spec["prompt"], spec["completion"]
+                )
+
+        try:
+            result = await loop.run_in_executor(None, do_score)
+        except (ValueError, TypeError) as e:
+            raise _HttpError(400, str(e))
+        await self._write(writer, _json_response(
+            200, result, close=conn.close_header(),
+        ))
+        return 200
 
     async def _cancel(self, rid: int, writer, conn) -> int:
         """``POST /v1/requests/{rid}/cancel`` — abort one in-flight
@@ -876,11 +925,18 @@ class Gateway:
             "driver-dead" if not alive
             else "stalled" if stalled else "ok"
         )
+        from elephas_tpu.utils import backend_guard
+
         body = {
             "status": status,
             "steps": steps,
             "queue_has_work": has_work,
             "driver_alive": alive,
+            # ISSUE 19 satellite: if jax backend discovery fell back
+            # to CPU (the BENCH_r05 driver-box TPU init crash), every
+            # health probe says so — report-only, never flips the
+            # 200/503 verdict (a CPU engine is slow, not dead)
+            "backend_fallback": backend_guard.last_fallback(),
         }
         if self.watchdog is not None:
             # anomaly detail (ISSUE 13): evaluated HERE, at probe

@@ -131,7 +131,10 @@ SlotKVCache`: host-side metadata only, the arrays are functional state
     pay nothing new."""
 
     def __init__(self, flash_layers, num_blocks: int, block_size: int,
-                 mesh=None, batch_axes=("data",), model_axis=None):
+                 mesh=None, batch_axes=("data",), model_axis=None,
+                 kv_dtype: str = "fp"):
+        from elephas_tpu.serving.kv_quant import check_kv_dtype
+
         self.specs = [
             (l.name, int(l.num_heads), int(l.head_dim))
             for l in flash_layers
@@ -143,14 +146,23 @@ SlotKVCache`: host-side metadata only, the arrays are functional state
             (batch_axes,) if isinstance(batch_axes, str) else batch_axes
         )
         self.model_axis = model_axis
+        self.kv_dtype = check_kv_dtype(kv_dtype)
 
     def nbytes(self) -> int:
-        """Host-side size estimate of the full (f32) block pool."""
-        per_pos = sum(h * d for _, h, d in self.specs) * 2 * 4
-        return self.num_blocks * self.block_size * per_pos
+        """Host-side size of the full block pool at its STORED dtype
+        — f32 values for ``kv_dtype="fp"``, int8/int4-packed codes
+        plus per-(position, head) f32 scales when quantized. This is
+        the per-device KV price the equal-bytes bench gate divides
+        by."""
+        from elephas_tpu.serving.kv_quant import pool_bytes_per_pos
+
+        return self.num_blocks * self.block_size * pool_bytes_per_pos(
+            self.specs, self.kv_dtype
+        )
 
     def constrain(self, z, heads: int):
-        """``[num_blocks, block_size, H, Dh]`` buffers: block axis
+        """``[num_blocks, block_size, H, Dh]`` buffers (and their
+        3-D ``[num_blocks, block_size, H]`` scale planes): block axis
         replicated, heads over the model axis when they tile."""
         if self.mesh is None:
             return z
@@ -164,39 +176,76 @@ SlotKVCache`: host-side metadata only, the arrays are functional state
             and heads % self.mesh.shape[self.model_axis] == 0
             else None
         )
+        spec = (
+            P(None, None, ax, None) if z.ndim == 4 else P(None, None, ax)
+        )
         return jax.lax.with_sharding_constraint(
-            z, NamedSharding(self.mesh, P(None, None, ax, None))
+            z, NamedSharding(self.mesh, spec)
         )
 
     def init(self) -> dict:
-        """The zeroed pool: ``{layer_name: (k, v)}``, each
-        ``[num_blocks, block_size, H, Dh]`` float32."""
+        """The zeroed pool: ``{layer_name: (k, v)}`` float32 for
+        ``kv_dtype="fp"``; ``{layer_name: (kq, vq, k_scale, v_scale)}``
+        when quantized — int8 ``[num_blocks, block_size, H, Dhp]``
+        codes (``Dhp`` = packed head dim) beside f32 ``[num_blocks,
+        block_size, H]`` scales. Zero codes with zero scales dequantize
+        to exact zeros, so the sentinel-row convention is unchanged."""
         import jax.numpy as jnp
 
-        return {
-            name: (
-                self.constrain(
-                    jnp.zeros(
-                        (self.num_blocks, self.block_size, h, d),
-                        jnp.float32,
-                    ),
-                    h,
+        from elephas_tpu.serving.kv_quant import packed_head_dim
+
+        if self.kv_dtype == "fp":
+            return {
+                name: tuple(
+                    self.constrain(
+                        jnp.zeros(
+                            (self.num_blocks, self.block_size, h, d),
+                            jnp.float32,
+                        ),
+                        h,
+                    )
+                    for _ in range(2)
+                )
+                for name, h, d in self.specs
+            }
+        out = {}
+        for name, h, d in self.specs:
+            dp = packed_head_dim(d, self.kv_dtype)
+            qz = lambda: self.constrain(
+                jnp.zeros(
+                    (self.num_blocks, self.block_size, h, dp), jnp.int8
                 ),
-                self.constrain(
-                    jnp.zeros(
-                        (self.num_blocks, self.block_size, h, d),
-                        jnp.float32,
-                    ),
-                    h,
-                ),
+                h,
             )
-            for name, h, d in self.specs
-        }
+            sz = lambda: self.constrain(
+                jnp.zeros(
+                    (self.num_blocks, self.block_size, h), jnp.float32
+                ),
+                h,
+            )
+            out[name] = (qz(), qz(), sz(), sz())
+        return out
+
+
+def _exact_onehot_einsum(eq, sels, x, out_dtype):
+    """One-hot contraction that stays EXACT for integer operands:
+    int8 pool codes contract in int32 (each output element is a single
+    nonzero term, so no overflow and no rounding) and cast back; float
+    operands keep the existing f32 path bit-for-bit."""
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(jnp.dtype(out_dtype), jnp.integer):
+        ops = [s.astype(jnp.int32) for s in sels]
+        ops.append(x.astype(jnp.int32))
+        return jnp.einsum(eq, *ops).astype(out_dtype)
+    ops = [s.astype(out_dtype) for s in sels]
+    ops.append(x.astype(out_dtype))
+    return jnp.einsum(eq, *ops)
 
 
 def paged_token_decode_step(model, w, tok, positions, pool, tables,
                             block_size, maxlen, active, local=False,
-                            attention="naive"):
+                            attention="naive", kv_dtype="fp"):
     """One decode step over the whole slot population, paged: slot
     ``b`` consumes ``tok[b]`` at absolute position ``positions[b]``,
     writes that position's K/V into pool row ``(tables[b, p // bs],
@@ -230,11 +279,25 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
     the ``[B, H, S]`` score row — float-tolerance parity, temp-0
     token-exact, same visible position set.
 
+    ``kv_dtype`` ``"int8"``/``"int4"`` (ISSUE 19): the pool entry is a
+    4-tuple ``(kq, vq, k_scale, v_scale)`` and this token's K/V rows
+    QUANTIZE ON WRITE (:mod:`elephas_tpu.serving.kv_quant`) — codes
+    and per-(position, head) scales land through the same one-hot /
+    native-scatter machinery (integer contractions run in int32, so
+    they stay exact), the table gather moves quantized bytes, and
+    dequantization happens inside the flash tile loop (or over the
+    full gathered span for the naive oracle). ``kv_dtype="fp"`` is
+    bit-for-bit the historical program.
+
     Returns ``(logits [num_slots, vocab], new_pool)``."""
     import jax
     import jax.numpy as jnp
 
     from elephas_tpu.ops.flash_serving import flash_span_decode
+    from elephas_tpu.serving.kv_quant import (
+        dequantize_rows,
+        quantize_rows,
+    )
 
     bs = int(block_size)
     T = int(tables.shape[1])
@@ -256,11 +319,19 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
     N_sentinel = next(iter(pool.values()))[0].shape[0]
     blk = jnp.where(blk_idx < T, blk, N_sentinel)
 
+    quant = kv_dtype != "fp"
+
     def attn_for(op):
         def attn(x, *_a, **_k):
-            pk, pv = pool[op.name]
+            entry = pool[op.name]
+            if quant:
+                pk, pv, sk, sv = entry
+            else:
+                pk, pv = entry
+                sk = sv = None
             N = int(pk.shape[0])
             H, Dh = op.num_heads, op.head_dim
+            Dhs = int(pk.shape[-1])  # stored width (packed for int4)
             B = x.shape[0]
             qkv = x @ w[op.qkv.kernel.path]  # [B, 3·H·Dh]
             q, k, v = jnp.split(
@@ -277,6 +348,12 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
                 )[:, None, :]
                 q = _apply_rope(q, cos_t, sin_t)
                 k = _apply_rope(k, cos_t, sin_t)
+            if quant:
+                # quantize-on-write: the row's codes + scales are what
+                # lands; fp k/v die with this trace
+                k, ks = quantize_rows(k, kv_dtype)
+                v, vs = quantize_rows(v, kv_dtype)
+            gks = gvs = None
             if local:
                 # unmeshed fast path: scatter this token's row at
                 # (blk, off) — inactive/overrun cursors route to the
@@ -291,9 +368,18 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
                     v.astype(pv.dtype), mode="drop"
                 )
                 gk = jnp.take(pk, tables, axis=0, mode="clip")
-                gk = gk.reshape(B, S, H, Dh)
+                gk = gk.reshape(B, S, H, Dhs)
                 gv = jnp.take(pv, tables, axis=0, mode="clip")
-                gv = gv.reshape(B, S, H, Dh)
+                gv = gv.reshape(B, S, H, Dhs)
+                if quant:
+                    sk = sk.at[blk_safe, off].set(ks, mode="drop")
+                    sv = sv.at[blk_safe, off].set(vs, mode="drop")
+                    gks = jnp.take(
+                        sk, tables, axis=0, mode="clip"
+                    ).reshape(B, S, H)
+                    gvs = jnp.take(
+                        sv, tables, axis=0, mode="clip"
+                    ).reshape(B, S, H)
             else:
                 # write: one token per active slot lands at (blk, off)
                 # — factored one-hot contraction over (block, offset);
@@ -302,13 +388,11 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
                 wsel = (blk[:, None] == jnp.arange(N)[None, :]) \
                     & active[:, None]  # [B, N]
                 osel = off[:, None] == jnp.arange(bs)[None, :]  # [B,bs]
-                new_k = jnp.einsum(
-                    "bn,bo,bhd->nohd",
-                    wsel.astype(pk.dtype), osel.astype(pk.dtype), k,
+                new_k = _exact_onehot_einsum(
+                    "bn,bo,bhd->nohd", (wsel, osel), k, pk.dtype
                 )
-                new_v = jnp.einsum(
-                    "bn,bo,bhd->nohd",
-                    wsel.astype(pv.dtype), osel.astype(pv.dtype), v,
+                new_v = _exact_onehot_einsum(
+                    "bn,bo,bhd->nohd", (wsel, osel), v, pv.dtype
                 )
                 covered = (
                     jnp.einsum(
@@ -324,17 +408,43 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
                 gsel = (
                     tables[:, :, None] == jnp.arange(N)[None, None, :]
                 )  # [B, T, N]
-                gk = jnp.einsum(
-                    "btn,nohd->btohd", gsel.astype(pk.dtype), pk
-                ).reshape(B, S, H, Dh)
-                gv = jnp.einsum(
-                    "btn,nohd->btohd", gsel.astype(pv.dtype), pv
-                ).reshape(B, S, H, Dh)
+                gk = _exact_onehot_einsum(
+                    "btn,nohd->btohd", (gsel,), pk, pk.dtype
+                ).reshape(B, S, H, Dhs)
+                gv = _exact_onehot_einsum(
+                    "btn,nohd->btohd", (gsel,), pv, pv.dtype
+                ).reshape(B, S, H, Dhs)
+                if quant:
+                    new_ks = jnp.einsum(
+                        "bn,bo,bh->noh",
+                        wsel.astype(sk.dtype), osel.astype(sk.dtype),
+                        ks,
+                    )
+                    new_vs = jnp.einsum(
+                        "bn,bo,bh->noh",
+                        wsel.astype(sv.dtype), osel.astype(sv.dtype),
+                        vs,
+                    )
+                    sk = jnp.where(covered[..., 0], new_ks, sk)
+                    sv = jnp.where(covered[..., 0], new_vs, sv)
+                    gks = jnp.einsum(
+                        "btn,noh->btoh", gsel.astype(sk.dtype), sk
+                    ).reshape(B, S, H)
+                    gvs = jnp.einsum(
+                        "btn,noh->btoh", gsel.astype(sv.dtype), sv
+                    ).reshape(B, S, H)
             if attention == "flash":
                 o = flash_span_decode(
-                    q, gk, gv, positions, scale=Dh**-0.5
+                    q, gk, gv, positions, scale=Dh**-0.5,
+                    kv_dtype=kv_dtype,
+                    kv_scales=(gks, gvs) if quant else None,
                 ).reshape(B, H * Dh)
             else:
+                if quant:
+                    # naive oracle: dequantize the gathered span once
+                    # (it materializes [B, H, S] scores anyway)
+                    gk = dequantize_rows(gk, gks, kv_dtype, Dh)
+                    gv = dequantize_rows(gv, gvs, kv_dtype, Dh)
                 # flash-lint: allow — the selectable naive oracle
                 att = jnp.einsum("bhd,bshd->bhs", q, gk) * (Dh**-0.5)
                 visible = (
@@ -348,7 +458,9 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
                 o = jnp.einsum("bhs,bshd->bhd", att, gv).reshape(
                     B, H * Dh
                 )
-            ctx_new[op.name] = (pk, pv)
+            ctx_new[op.name] = (
+                (pk, pv, sk, sv) if quant else (pk, pv)
+            )
             return (
                 o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
             )
@@ -366,7 +478,8 @@ kv_cache.token_decode_step` — einsum strings and operation order kept
 
 def paged_chunk_forward(model, w, tokens_chunk, pool, tables, offsets,
                         chunk_lens, active, block_size, maxlen,
-                        local=False, attention="naive"):
+                        local=False, attention="naive",
+                        kv_dtype="fp"):
     """Prefill a bounded chunk of each active slot's prompt into its
     block-table rows — the ONLY prefill program paged mode needs: a
     cold prompt is one full-width chunk from offset 0 (or several under
@@ -380,13 +493,21 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
     over the gathered table span — shared prefix blocks, earlier
     chunks, and the chunk's own causal part. Compiled per (chunk width
     ``C``, table bucket ``T``) pair — both from closed ladders.
-    ``local``/``attention`` as in :func:`paged_token_decode_step`.
+    ``local``/``attention``/``kv_dtype`` as in
+    :func:`paged_token_decode_step` — quantized pools land this
+    chunk's codes + scales through the same write machinery and
+    dequantize inside the flash tiles (or over the gathered span for
+    the naive oracle).
 
     Returns ``(logits [num_slots, C, vocab], new_pool)``."""
     import jax
     import jax.numpy as jnp
 
     from elephas_tpu.ops.flash_serving import flash_span_chunk
+    from elephas_tpu.serving.kv_quant import (
+        dequantize_rows,
+        quantize_rows,
+    )
 
     bs = int(block_size)
     C = int(tokens_chunk.shape[1])
@@ -406,11 +527,19 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
         jnp.where(t_onehot, tables[:, None, :], 0), axis=2
     )  # [B, C]
 
+    quant = kv_dtype != "fp"
+
     def attn_for(op):
         def attn(x, *_a, **_k):
-            pk, pv = pool[op.name]
+            entry = pool[op.name]
+            if quant:
+                pk, pv, sk, sv = entry
+            else:
+                pk, pv = entry
+                sk = sv = None
             N = int(pk.shape[0])
             H, Dh = op.num_heads, op.head_dim
+            Dhs = int(pk.shape[-1])  # stored width (packed for int4)
             B = x.shape[0]
             qkv = jnp.reshape(
                 x @ w[op.qkv.kernel.path], (B, C, 3, H, Dh)
@@ -429,6 +558,12 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
                 k = _apply_rope(k, cos, sin)
             k_rows = jnp.transpose(k, (0, 2, 1, 3))  # [B, C, H, Dh]
             v_rows = jnp.transpose(v, (0, 2, 1, 3))
+            if quant:
+                # quantize-on-write: codes + per-(pos, head) scales
+                # are what lands; fp rows die with this trace
+                k_rows, ks_rows = quantize_rows(k_rows, kv_dtype)
+                v_rows, vs_rows = quantize_rows(v_rows, kv_dtype)
+            gks = gvs = None
             if local:
                 # unmeshed fast path: scatter the chunk's rows at
                 # (blk, off) — padded/inactive lanes route to the
@@ -441,9 +576,22 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
                     v_rows.astype(pv.dtype), mode="drop"
                 )
                 gk = jnp.take(pk, tables, axis=0, mode="clip")
-                gk = gk.reshape(B, S, H, Dh)
+                gk = gk.reshape(B, S, H, Dhs)
                 gv = jnp.take(pv, tables, axis=0, mode="clip")
-                gv = gv.reshape(B, S, H, Dh)
+                gv = gv.reshape(B, S, H, Dhs)
+                if quant:
+                    sk = sk.at[blk_safe, off_mat].set(
+                        ks_rows, mode="drop"
+                    )
+                    sv = sv.at[blk_safe, off_mat].set(
+                        vs_rows, mode="drop"
+                    )
+                    gks = jnp.take(
+                        sk, tables, axis=0, mode="clip"
+                    ).reshape(B, S, H)
+                    gvs = jnp.take(
+                        sv, tables, axis=0, mode="clip"
+                    ).reshape(B, S, H)
             else:
                 # land the chunk's rows first: factored one-hot over
                 # (block, offset); `valid` rides the block select so a
@@ -456,15 +604,13 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
                     off_mat[:, :, None]
                     == jnp.arange(bs)[None, None, :]
                 )  # [B, C, bs]
-                scat_k = jnp.einsum(
-                    "bcn,bco,bchd->nohd",
-                    nsel.astype(pk.dtype), osel.astype(pk.dtype),
-                    k_rows,
+                scat_k = _exact_onehot_einsum(
+                    "bcn,bco,bchd->nohd", (nsel, osel), k_rows,
+                    pk.dtype,
                 )
-                scat_v = jnp.einsum(
-                    "bcn,bco,bchd->nohd",
-                    nsel.astype(pv.dtype), osel.astype(pv.dtype),
-                    v_rows,
+                scat_v = _exact_onehot_einsum(
+                    "bcn,bco,bchd->nohd", (nsel, osel), v_rows,
+                    pv.dtype,
                 )
                 covered = (
                     jnp.einsum(
@@ -478,17 +624,42 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
                 gsel = (
                     tables[:, :, None] == jnp.arange(N)[None, None, :]
                 )  # [B, T, N]
-                gk = jnp.einsum(
-                    "btn,nohd->btohd", gsel.astype(pk.dtype), pk
-                ).reshape(B, S, H, Dh)
-                gv = jnp.einsum(
-                    "btn,nohd->btohd", gsel.astype(pv.dtype), pv
-                ).reshape(B, S, H, Dh)
+                gk = _exact_onehot_einsum(
+                    "btn,nohd->btohd", (gsel,), pk, pk.dtype
+                ).reshape(B, S, H, Dhs)
+                gv = _exact_onehot_einsum(
+                    "btn,nohd->btohd", (gsel,), pv, pv.dtype
+                ).reshape(B, S, H, Dhs)
+                if quant:
+                    scat_ks = jnp.einsum(
+                        "bcn,bco,bch->noh",
+                        nsel.astype(sk.dtype), osel.astype(sk.dtype),
+                        ks_rows,
+                    )
+                    scat_vs = jnp.einsum(
+                        "bcn,bco,bch->noh",
+                        nsel.astype(sv.dtype), osel.astype(sv.dtype),
+                        vs_rows,
+                    )
+                    sk = jnp.where(covered[..., 0], scat_ks, sk)
+                    sv = jnp.where(covered[..., 0], scat_vs, sv)
+                    gks = jnp.einsum(
+                        "btn,noh->btoh", gsel.astype(sk.dtype), sk
+                    ).reshape(B, S, H)
+                    gvs = jnp.einsum(
+                        "btn,noh->btoh", gsel.astype(sv.dtype), sv
+                    ).reshape(B, S, H)
             if attention == "flash":
                 o = flash_span_chunk(
-                    q, gk, gv, pos_mat, scale=Dh**-0.5
+                    q, gk, gv, pos_mat, scale=Dh**-0.5,
+                    kv_dtype=kv_dtype,
+                    kv_scales=(gks, gvs) if quant else None,
                 )
             else:
+                if quant:
+                    # naive oracle: dequantize the gathered span once
+                    gk = dequantize_rows(gk, gks, kv_dtype, Dh)
+                    gv = dequantize_rows(gv, gvs, kv_dtype, Dh)
                 # flash-lint: allow — the selectable naive oracle
                 att = jnp.einsum(
                     "bhcd,bshd->bhcs", q, gk
@@ -505,7 +676,9 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
             o = jnp.reshape(
                 jnp.transpose(o, (0, 2, 1, 3)), (B, C, H * Dh)
             )
-            ctx_new[op.name] = (pk, pv)
+            ctx_new[op.name] = (
+                (pk, pv, sk, sv) if quant else (pk, pv)
+            )
             return (
                 o @ w[op.proj.kernel.path] + w[op.proj.bias.path]
             )
@@ -523,7 +696,8 @@ chunked_prefill_forward`: this chunk's K/V rows land in the pool FIRST
 
 def paged_verify_forward(model, w, tokens_window, pool, tables,
                          offsets, n_fed, active, block_size, maxlen,
-                         local=False, attention="naive"):
+                         local=False, attention="naive",
+                         kv_dtype="fp"):
     """Batched K-token speculative verify over the PAGED arena (ISSUE
     8) — the block-table analogue of :func:`~elephas_tpu.serving.\
 kv_cache.verify_forward`: slot ``b`` feeds its last sampled token plus
@@ -542,26 +716,30 @@ kv_cache.verify_forward`: slot ``b`` feeds its last sampled token plus
     return paged_chunk_forward(
         model, w, tokens_window, pool, tables, offsets, n_fed, active,
         block_size, maxlen, local=local, attention=attention,
+        kv_dtype=kv_dtype,
     )
 
 
 def gather_blocks(pool, ids):
     """Read pool blocks ``ids`` (``[T]`` int32, sentinel-padded) into
-    dense ``{layer: (k, v)}`` rows of shape ``[T, block_size, H, Dh]``
-    — the device half of preemption offload: the caller
-    ``device_get``s the result and frees the blocks. One-hot over the
-    block axis (exact, mesh-safe); sentinel rows read zeros and are
-    sliced off on the host. The pool is NOT consumed."""
+    dense per-layer rows of shape ``[T, block_size, ...]`` — the
+    device half of preemption offload: the caller ``device_get``s the
+    result and frees the blocks. One-hot over the block axis (exact,
+    mesh-safe — integer pool leaves contract in int32); sentinel rows
+    read zeros and are sliced off on the host. LEAF-GENERIC over the
+    pool's tuple arity: fp entries stay ``(k, v)``, quantized entries
+    move all four of ``(kq, vq, k_scale, v_scale)`` — offloaded
+    blocks stay quantized, values and scales travel together. The
+    pool is NOT consumed."""
     import jax.numpy as jnp
 
     out = {}
-    for name, (pk, pv) in pool.items():
-        sel = (
-            ids[:, None] == jnp.arange(pk.shape[0])[None, :]
-        )  # [T, N]
-        out[name] = (
-            jnp.einsum("tn,nohd->tohd", sel.astype(pk.dtype), pk),
-            jnp.einsum("tn,nohd->tohd", sel.astype(pv.dtype), pv),
+    for name, leaves in pool.items():
+        N = int(leaves[0].shape[0])
+        sel = ids[:, None] == jnp.arange(N)[None, :]  # [T, N]
+        out[name] = tuple(
+            _exact_onehot_einsum("tn,n...->t...", (sel,), z, z.dtype)
+            for z in leaves
         )
     return out
 
@@ -569,25 +747,24 @@ def gather_blocks(pool, ids):
 def scatter_blocks(pool, ids, rows):
     """Write dense rows back into pool blocks ``ids`` — the resume
     half of preempt/offload: restored rows are bitwise the offloaded
-    ones, so the resumed request's attention sees exactly the K/V it
-    had. Sentinel ids write nowhere. Returns the new pool."""
+    ones (quantized codes and scales included — bit-exact WITHIN a
+    kv_dtype), so the resumed request's attention sees exactly the
+    K/V it had. Sentinel ids write nowhere. Leaf-generic like
+    :func:`gather_blocks`. Returns the new pool."""
     import jax.numpy as jnp
 
     out = {}
-    for name, (pk, pv) in pool.items():
-        rk, rv = rows[name]
-        sel = (
-            ids[:, None] == jnp.arange(pk.shape[0])[None, :]
-        )  # [T, N]
-        new_k = jnp.einsum(
-            "tn,tohd->nohd", sel.astype(pk.dtype), rk.astype(pk.dtype)
-        )
-        new_v = jnp.einsum(
-            "tn,tohd->nohd", sel.astype(pv.dtype), rv.astype(pv.dtype)
-        )
-        covered = jnp.any(sel, axis=0)[:, None, None, None]  # [N,1,1,1]
-        out[name] = (
-            jnp.where(covered, new_k, pk),
-            jnp.where(covered, new_v, pv),
-        )
+    for name, leaves in pool.items():
+        rleaves = rows[name]
+        N = int(leaves[0].shape[0])
+        sel = ids[:, None] == jnp.arange(N)[None, :]  # [T, N]
+        covered = jnp.any(sel, axis=0)  # [N]
+        merged = []
+        for z, r in zip(leaves, rleaves):
+            new_z = _exact_onehot_einsum(
+                "tn,t...->n...", (sel,), r.astype(z.dtype), z.dtype
+            )
+            cov = covered.reshape((N,) + (1,) * (z.ndim - 1))
+            merged.append(jnp.where(cov, new_z, z))
+        out[name] = tuple(merged)
     return out

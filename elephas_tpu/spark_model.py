@@ -1216,6 +1216,7 @@ class SparkModel:
         block_size: int | None = None,
         num_blocks: int | None = None,
         preemption: bool = False,
+        kv_dtype: str = "fp",
         speculative: bool = False,
         spec_k: int | None = None,
         spec_drafter=None,
@@ -1251,6 +1252,16 @@ InferenceEngine` over this wrapper's mesh — the serving analogue of
         arena), copy-free prefix sharing when ``prefix_cache=True``,
         and — with ``preemption=True`` — priority-based preempt/
         host-offload/resume under pool pressure.
+
+        ``kv_dtype=`` (ISSUE 19) selects the paged arena's KV storage:
+        ``"fp"`` (default) keeps float32 blocks and IS the parity
+        oracle; ``"int8"`` / ``"int4"`` store quantized codes with
+        per-(position, head) scales — ~3.5x / ~6x fewer KV bytes per
+        position, so proportionally more admitted concurrency on the
+        same per-device KV budget, at the price of temp-0 exactness
+        vs the fp oracle (quality is gated by token agreement via
+        ``engine.score()`` / ``POST /v1/score``; see docs/API.md
+        "Quantized KV"). Requires ``paged=True``.
 
         ``speculative=True`` (ISSUE 8) turns on draft-and-verify
         decoding: ``spec_drafter`` (``"ngram"`` prompt-lookup by
@@ -1316,6 +1327,7 @@ Policy` instance. ``gateway_port=`` (0 = ephemeral) additionally
             block_size=block_size,
             num_blocks=num_blocks,
             preemption=preemption,
+            kv_dtype=kv_dtype,
             speculative=speculative,
             spec_k=spec_k,
             spec_drafter=spec_drafter,

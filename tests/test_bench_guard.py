@@ -351,6 +351,15 @@ class TestFaultPathLint:
             root, "elephas_tpu", "utils", "backend_guard.py"
         ))
         assert os.path.exists(files[-1])
+        # ISSUE 19: the quantized-KV codec quantizes on the serving
+        # write path and dequantizes inside the attention tiles — a
+        # swallowed error there serves silently garbage attention or
+        # lands corrupt blocks in the pool; pinned by name so a rename
+        # cannot drop it out of the serving glob
+        assert any(
+            f.endswith(os.path.join("serving", "kv_quant.py"))
+            for f in files
+        )
         return root, files
 
     def test_no_bare_or_swallowed_excepts_on_fault_paths(self):
@@ -579,6 +588,13 @@ class TestTelemetryWallClockLint:
         # landing path the same way
         assert any(
             f.endswith(os.path.join("serving", "sp_prefill.py"))
+            for f in files
+        )
+        # ISSUE 19: quantize-on-write runs INSIDE gang-replicated
+        # serving programs — wall clock in the codec would fork
+        # compiled behavior across processes; pinned by name
+        assert any(
+            f.endswith(os.path.join("serving", "kv_quant.py"))
             for f in files
         )
         assert any(
