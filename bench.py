@@ -34,7 +34,9 @@ import gc
 import json
 import logging
 import os
+import shutil
 import sys
+import tempfile
 import time
 
 os.environ.setdefault("KERAS_BACKEND", "jax")
@@ -3184,6 +3186,587 @@ def measure_fleet(n_requests: int, num_slots: int, seed: int = 0):
     }
 
 
+def _deploy_store(model):
+    """One in-process PS holding the model's weights (never started —
+    the deploy sections exercise the versioning surfaces, not the
+    socket; the chaos section is where real sockets die)."""
+    import numpy as np
+
+    from elephas_tpu.parameter import SocketServer
+
+    return SocketServer(
+        [np.asarray(w) for w in model.get_weights()],
+        mode="asynchronous", port=0,
+    )
+
+
+def _deploy_livepush_section(model, maxlen, vocab, num_slots=4,
+                             n_requests=12, pushes=3, seed=51):
+    """Live weight-push p99 (ISSUE 20 gate 1): the IDENTICAL
+    closed-loop workload runs twice over a paged engine — steady
+    state, then with the ledger publishing a fresh generation (same
+    content, new number) at evenly spaced points and the subscriber
+    applying each between requests. Every apply pays the full
+    deployment cost on-path: ``model.set_weights`` + the engine's
+    ``refresh_weights(version=)`` (prefix-cache flush, donor
+    quarantine, version re-stamp).
+
+    The preset REFUSES JSON unless: every generation published during
+    the drive applied exactly once (the subscriber kept up, no skips);
+    the pushed arm's token streams are IDENTICAL to steady state (the
+    re-published content is bit-identical, so a changed stream means
+    an apply tore a request); and pushed p99 <= 5x steady p99 — a
+    live deployment must degrade tail latency boundedly, never turn
+    p99 into seconds."""
+    import numpy as np
+
+    from elephas_tpu.deploy import VersionLedger, WeightSubscriber
+
+    rng = np.random.default_rng(seed)
+    p_len = 16
+    budget = min(48, maxlen - p_len - 16)
+    prompts = [
+        rng.integers(1, vocab, size=p_len).astype(np.int32)
+        for _ in range(n_requests)
+    ]
+    # publish points, evenly spaced strictly inside the drive
+    push_at = {
+        (i + 1) * n_requests // (pushes + 1) for i in range(pushes)
+    }
+
+    def warm(engine):
+        engine.run([(
+            rng.integers(1, vocab, size=p_len).astype(np.int32),
+            budget,
+        )])
+
+    def drive(engine, between=None):
+        lats, streams = [], []
+        for i, p in enumerate(prompts):
+            if between is not None:
+                between(i)
+            t0 = time.perf_counter()
+            out = engine.run([(p, budget)])
+            lats.append(time.perf_counter() - t0)
+            streams.append(list(out.values())[0].tolist())
+        return lats, streams
+
+    steady_eng = _fleet_engine(model, maxlen, num_slots)
+    warm(steady_eng)
+    steady_lats, steady_streams = drive(steady_eng)
+    steady_eng.release_telemetry()
+    if sum(steady_lats) <= MIN_CREDIBLE_DT:
+        raise ImplausibleTiming(
+            f"deploy livepush steady drive {sum(steady_lats):.4f}s "
+            f"below the {MIN_CREDIBLE_DT}s credibility floor"
+        )
+
+    push_eng = _fleet_engine(model, maxlen, num_slots)
+    warm(push_eng)
+    store = _deploy_store(model)
+    ledger = VersionLedger(store)
+    sub = WeightSubscriber(push_eng, store, staleness_bound=1)
+    content = [np.asarray(w).copy() for w in model.get_weights()]
+
+    def between(i):
+        if i in push_at:
+            version = ledger.publish([w.copy() for w in content])
+            applied = sub.poll_once()
+            if applied != version:
+                raise ImplausibleTiming(
+                    f"deploy livepush gate: generation {version} "
+                    f"published mid-drive but the subscriber applied "
+                    f"{applied} (status={sub.status()})"
+                )
+
+    push_lats, push_streams = drive(push_eng, between)
+
+    if sub.applies != len(push_at) or any(sub.skips.values()):
+        raise ImplausibleTiming(
+            f"deploy livepush gate: {len(push_at)} generations "
+            f"published but {sub.applies} applied with skips "
+            f"{sub.skips} — the subscriber did not keep up"
+        )
+    if push_eng.weight_version != ledger.version:
+        raise ImplausibleTiming(
+            f"deploy livepush gate: engine serves generation "
+            f"{push_eng.weight_version} but the ledger minted "
+            f"{ledger.version}"
+        )
+    if push_streams != steady_streams:
+        raise ImplausibleTiming(
+            "deploy livepush gate: token streams diverged from steady "
+            "state though every pushed generation was bit-identical "
+            "content — an apply tore a request"
+        )
+    steady_p99 = float(np.percentile(
+        [t * 1e3 for t in steady_lats], 99
+    ))
+    push_p99 = float(np.percentile([t * 1e3 for t in push_lats], 99))
+    ratio = push_p99 / max(1e-9, steady_p99)
+    if ratio > 5.0:
+        raise ImplausibleTiming(
+            f"deploy livepush gate: p99 during live pushes "
+            f"{push_p99:.1f}ms is {ratio:.2f}x steady state "
+            f"{steady_p99:.1f}ms — over the 5x bounded-degradation "
+            f"ceiling"
+        )
+    sub.release_telemetry()
+    ledger.release_telemetry()
+    push_eng.release_telemetry()
+    return {
+        "requests": n_requests,
+        "budget_tokens": budget,
+        "pushes": len(push_at),
+        "generations_applied": sub.applies,
+        "p99_steady_ms": round(steady_p99, 1),
+        "p99_push_ms": round(push_p99, 1),
+        "p99_ratio": round(ratio, 2),
+        "p50_steady_ms": round(float(np.percentile(
+            [t * 1e3 for t in steady_lats], 50)), 1),
+        "p50_push_ms": round(float(np.percentile(
+            [t * 1e3 for t in push_lats], 50)), 1),
+        "token_exact": True,
+    }
+
+
+def _deploy_canary_section(model, maxlen, vocab, num_slots=4, seed=53):
+    """Canary → ``slo_burn`` → auto-rollback (ISSUE 20 gate 2): a
+    two-replica router runs a canary cycle whose candidate generation
+    is deliberately driven into TTFT-deadline misses (sub-ms deadlines
+    no real first token can meet — physically honest misses, not
+    mocked counters). The controller's next evaluation must see the
+    ``slo_burn`` anomaly on the fleet-scraper view and auto-rollback.
+
+    REFUSES JSON unless: the cycle concludes ``rolled_back``; the
+    watchdog fired EXACTLY one anomaly and cleared EXACTLY one (the
+    fired/cleared count criterion); every replica — canary included —
+    converges on the rollback generation; and the router's canary
+    split is cleared."""
+    import numpy as np
+
+    from elephas_tpu.deploy import (
+        CanaryController,
+        VersionLedger,
+        WeightSubscriber,
+    )
+    from elephas_tpu.fleet import Router
+    from elephas_tpu.serving import InferenceEngine, blocks_for
+    from elephas_tpu.serving.policy import FairSharePolicy
+
+    rng = np.random.default_rng(seed)
+    p_len, budget = 12, 16
+
+    def mk_engine():
+        # deadline-aware policy: submit(ttft_deadline_ms=) must reach
+        # the engine for slo_met/missed accounting
+        return InferenceEngine(
+            model, num_slots=num_slots, paged=True, block_size=16,
+            num_blocks=num_slots * blocks_for(maxlen, 16),
+            preemption=True, prefix_cache=True,
+            policy=FairSharePolicy(),
+        )
+
+    engines = {"stable": mk_engine(), "canary": mk_engine()}
+    store = _deploy_store(model)
+    ledger = VersionLedger(store)
+    subs = {
+        name: WeightSubscriber(eng, store)
+        for name, eng in engines.items()
+    }
+    content = [np.asarray(w).copy() for w in model.get_weights()]
+    generous_ms = 60_000.0
+
+    router = Router(engines, poll_every=4)
+    with router:
+        ctrl = CanaryController(
+            router, ledger, subs, canary=["canary"], share=0.5,
+            window=4,
+        )
+        # prime the delta-based slo_burn baselines before any traffic
+        router.scraper.poll()
+        ctrl.watchdog.evaluate()
+
+        candidate = ctrl.begin([w.copy() for w in content])
+        split_reqs = [
+            router.submit(
+                rng.integers(1, vocab, size=p_len).astype(np.int32),
+                budget, ttft_deadline_ms=generous_ms,
+            )
+            for _ in range(6)
+        ]
+        assert all(r.wait(120) for r in split_reqs)
+        canary_hits = router.canary_status()["placements_seen"]
+        if canary_hits < 1:
+            raise ImplausibleTiming(
+                "deploy canary gate: the deterministic 0.5 split "
+                "placed nothing on the canary pool across 6 requests"
+            )
+        router.scraper.poll()
+        if ctrl.evaluate() != "canary":
+            raise ImplausibleTiming(
+                "deploy canary gate: the cycle concluded on met-"
+                "deadline traffic — the burn detector is hair-trigger"
+            )
+        # burn the candidate: steer EVERYTHING canary-ward and submit
+        # deadlines (0.001ms) no real first token can meet
+        router.set_canary(["canary"], 1.0)
+        burn_reqs = [
+            router.submit(
+                rng.integers(1, vocab, size=p_len).astype(np.int32),
+                budget, ttft_deadline_ms=0.001,
+            )
+            for _ in range(6)
+        ]
+        assert all(r.wait(120) for r in burn_reqs)
+        router.scraper.poll()
+        state = ctrl.evaluate()
+        if state != "idle" or ctrl.last_outcome != "rolled_back":
+            raise ImplausibleTiming(
+                f"deploy canary gate: expected slo_burn to roll the "
+                f"cycle back, got state={state!r} "
+                f"outcome={ctrl.last_outcome!r}"
+            )
+        # a quiet window clears the anomaly
+        router.scraper.poll()
+        ctrl.watchdog.evaluate()
+        report = ctrl.watchdog.report()
+        if report["fired_total"] != 1 or report["cleared_total"] != 1:
+            raise ImplausibleTiming(
+                f"deploy canary gate: watchdog fired "
+                f"{report['fired_total']} and cleared "
+                f"{report['cleared_total']} anomalies — the criterion "
+                f"is exactly one of each"
+            )
+        restored = ledger.version
+        bad = {
+            name: sub.applied_version
+            for name, sub in subs.items()
+            if sub.applied_version != restored
+        }
+        if bad:
+            raise ImplausibleTiming(
+                f"deploy canary gate: replicas {bad} did not converge "
+                f"on the rollback generation {restored}"
+            )
+        if router.canary_status()["share"] != 0.0:
+            raise ImplausibleTiming(
+                "deploy canary gate: the traffic split survived the "
+                "rollback"
+            )
+    router.release_telemetry()
+    ctrl.release_telemetry()
+    ctrl.watchdog.release_telemetry()
+    for sub in subs.values():
+        sub.release_telemetry()
+    ledger.release_telemetry()
+    for eng in engines.values():
+        eng.release_telemetry()
+    return {
+        "candidate_generation": candidate,
+        "rollback_generation": restored,
+        "canary_placements": int(canary_hits),
+        "watchdog_fired": report["fired_total"],
+        "watchdog_cleared": report["cleared_total"],
+        "outcome": "rolled_back",
+    }
+
+
+def _deploy_chaos_section(model, maxlen, vocab, num_slots=4, seed=57):
+    """Shard-kill mid-deployment (ISSUE 20 gate 3): a 2-shard
+    journaled PS loses shard 0 immediately before a publication, so
+    generation 2 reaches only shard 1. Subscribers must skip the
+    outage (wire errors) AND the post-restart mixed cut (shard 0
+    rejoins from its journal on generation 1) — then the next
+    publication re-converges the store and every replica applies it
+    exactly once.
+
+    REFUSES JSON unless: every replica lands on the final generation;
+    each subscriber applied exactly the distinct generations it
+    served (zero double-applies); both skip reasons were actually
+    exercised; and the restarted shard restored from its journal."""
+    import numpy as np
+
+    from elephas_tpu.deploy import VersionLedger, WeightSubscriber
+    from elephas_tpu.fault.harness import (
+        DeployChaosStore,
+        ShardedRestartablePS,
+    )
+    from elephas_tpu.parameter import ShardedClient, SocketServer
+
+    rng = np.random.default_rng(seed)
+    weights = [np.asarray(w) for w in model.get_weights()]
+    tmp = tempfile.mkdtemp(prefix="elephas-deploy-chaos-")
+    harness = ShardedRestartablePS(
+        SocketServer, weights, num_shards=2,
+        journal_dir=tmp, journal_every=1,
+    )
+    engines, subs, clients = {}, {}, {}
+    try:
+        store = DeployChaosStore(harness)
+        ledger = VersionLedger(store)
+        for name in ("a", "b", "c"):
+            engines[name] = _fleet_engine(model, maxlen, num_slots)
+            clients[name] = ShardedClient(
+                harness.endpoints, harness.shard_map,
+            )
+            subs[name] = WeightSubscriber(
+                engines[name], clients[name], staleness_bound=1,
+            )
+        # generation 1 lands everywhere
+        ledger.publish([w.copy() for w in weights])
+        for name, sub in subs.items():
+            if sub.poll_once() != 1:
+                raise ImplausibleTiming(
+                    f"deploy chaos: replica {name} failed to apply "
+                    f"generation 1 (status={sub.status()})"
+                )
+        # kill shard 0, then publish: generation 2 reaches shard 1
+        # only — the honest mid-deployment crash
+        harness.kill(0)
+        ledger.publish([w.copy() for w in weights])
+        for name, sub in subs.items():
+            if sub.poll_once() is not None:
+                raise ImplausibleTiming(
+                    f"deploy chaos: replica {name} applied a "
+                    f"generation during the shard outage"
+                )
+        harness.restart(0)
+        if not harness.servers[0].restored_from_journal:
+            raise ImplausibleTiming(
+                "deploy chaos: the restarted shard did not restore "
+                "from its journal"
+            )
+        # shard 0 rejoined on generation 1, shard 1 serves 2 — a
+        # mixed cut no subscriber may apply
+        if ledger.status()["converged"]:
+            raise ImplausibleTiming(
+                "deploy chaos: the store reports a converged cut "
+                "with one shard a generation behind"
+            )
+        for name, sub in subs.items():
+            if sub.poll_once() is not None:
+                raise ImplausibleTiming(
+                    f"deploy chaos: replica {name} applied a MIXED "
+                    f"version cut (status={sub.status()})"
+                )
+        # the next publication re-converges every shard
+        final = ledger.publish([w.copy() for w in weights])
+        for name, sub in subs.items():
+            if sub.poll_once() != final:
+                raise ImplausibleTiming(
+                    f"deploy chaos: replica {name} did not converge "
+                    f"on generation {final} "
+                    f"(status={sub.status()})"
+                )
+        if not ledger.status()["converged"]:
+            raise ImplausibleTiming(
+                "deploy chaos: shards still disagree after the "
+                "re-converging publication"
+            )
+        for name, sub in subs.items():
+            st = sub.status()
+            if st["applies"] != 2:
+                raise ImplausibleTiming(
+                    f"deploy chaos gate: replica {name} applied "
+                    f"{st['applies']} times for 2 distinct served "
+                    f"generations — a double-apply (or a miss)"
+                )
+            if not st["skips"]["wire_error"]:
+                raise ImplausibleTiming(
+                    f"deploy chaos: replica {name} never saw the "
+                    f"outage — the kill was not load-bearing"
+                )
+            if not st["skips"]["mixed_cut"]:
+                raise ImplausibleTiming(
+                    f"deploy chaos: replica {name} never saw the "
+                    f"mixed cut — the torn deployment was not "
+                    f"load-bearing"
+                )
+        # every replica still serves, stamped with the final
+        # generation
+        for name, eng in engines.items():
+            out = eng.run([(
+                rng.integers(1, vocab, size=8).astype(np.int32), 8,
+            )])
+            if len(out) != 1:
+                raise ImplausibleTiming(
+                    f"deploy chaos: replica {name} failed to serve "
+                    f"after convergence"
+                )
+            if eng.stats()["weight_version"] != final:
+                raise ImplausibleTiming(
+                    f"deploy chaos: replica {name} serves stamped "
+                    f"generation {eng.stats()['weight_version']}, "
+                    f"expected {final}"
+                )
+        applied = {s.applied_version for s in subs.values()}
+        counters = harness.counters()
+        out = {
+            "replicas": len(subs),
+            "shards": harness.num_shards,
+            "killed_shard": 0,
+            "final_generation": final,
+            "converged_versions": sorted(applied),
+            "applies_per_replica": 2,
+            "double_applies": 0,
+            "wire_error_skips": sum(
+                s.skips["wire_error"] for s in subs.values()
+            ),
+            "mixed_cut_skips": sum(
+                s.skips["mixed_cut"] for s in subs.values()
+            ),
+            "journal_restored": True,
+            "ps_updates_duplicate": counters["updates_duplicate"],
+        }
+    finally:
+        for sub in subs.values():
+            sub.release_telemetry()
+        for client in clients.values():
+            client.close()
+            client.release_telemetry()
+        for eng in engines.values():
+            eng.release_telemetry()
+        try:
+            ledger.release_telemetry()
+        except NameError:
+            pass
+        harness.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _deploy_migration_section(model, maxlen, vocab, seed=59):
+    """Cross-generation migration refusal (ISSUE 20 gate 4): a warm
+    request exported from an engine serving generation 5 must be
+    REFUSED by an engine serving generation 7 (its K/V came from
+    different weights — resuming would splice incompatible caches),
+    and accepted verbatim once the target serves generation 5.
+
+    REFUSES JSON unless the mismatch raises loudly (naming
+    ``weight_ver``) and the matched import completes the stream."""
+    import numpy as np
+
+    from elephas_tpu.fleet.migration import decode_record, encode_record
+
+    rng = np.random.default_rng(seed)
+    prompt = rng.integers(1, vocab, size=12).astype(np.int32)
+    budget = 16
+    A = _fleet_engine(model, maxlen, 4)
+    B = _fleet_engine(model, maxlen, 4)
+    A.refresh_weights(version=5)
+    B.refresh_weights(version=7)
+    ra = A.submit(prompt, budget)
+    for _ in range(4):
+        A.step()
+    payload = A.export_request(ra.rid)
+    if payload["weight_ver"] != 5 or payload["n_blocks"] == 0:
+        raise ImplausibleTiming(
+            f"deploy migration: export carried weight_ver="
+            f"{payload['weight_ver']} n_blocks={payload['n_blocks']} "
+            f"— expected a warm generation-5 record"
+        )
+    record = decode_record(encode_record(payload))
+    refused = False
+    try:
+        B.import_request(record)
+    except ValueError as e:
+        refused = "weight_ver" in str(e)
+    if not refused:
+        raise ImplausibleTiming(
+            "deploy migration gate: an engine on generation 7 "
+            "accepted (or refused without naming weight_ver) a warm "
+            "generation-5 record"
+        )
+    B.refresh_weights(version=5)
+    rb = B.import_request(record)
+    while B.scheduler.has_work:
+        B.step()
+    if rb.error is not None or not rb.done:
+        raise ImplausibleTiming(
+            "deploy migration gate: the matched-generation import "
+            "failed to complete"
+        )
+    A.release_telemetry()
+    B.release_telemetry()
+    return {
+        "exported_generation": 5,
+        "target_generation": 7,
+        "mismatch_refused": True,
+        "matched_import_tokens": len(rb.tokens),
+    }
+
+
+def measure_deploy(n_requests: int, num_slots: int, seed: int = 0):
+    """``--preset deploy`` (ISSUE 20): the train-while-serving tier —
+    tail latency during live weight pushes, the canary → ``slo_burn``
+    → auto-rollback state machine, the mid-deployment shard-kill
+    convergence story, and the cross-generation migration refusal.
+    Every section is GATED (see each section's docstring); a miss
+    refuses the JSON record entirely."""
+    from elephas_tpu.models import transformer_lm
+
+    vocab, maxlen = 256, 128
+    toy = transformer_lm(
+        vocab_size=vocab, maxlen=maxlen, d_model=64, num_heads=2,
+        num_layers=2, dropout=0.0, seed=0,
+    )
+    livepush = _deploy_livepush_section(
+        toy, maxlen, vocab, num_slots=num_slots,
+        n_requests=n_requests, seed=seed + 51,
+    )
+    log.info(
+        "deploy livepush: p99 %.1fms with %d live pushes vs %.1fms "
+        "steady (%.2fx, <=5x required), %d/%d generations applied, "
+        "token-exact",
+        livepush["p99_push_ms"], livepush["pushes"],
+        livepush["p99_steady_ms"], livepush["p99_ratio"],
+        livepush["generations_applied"], livepush["pushes"],
+    )
+    canary = _deploy_canary_section(
+        toy, maxlen, vocab, num_slots=num_slots, seed=seed + 53,
+    )
+    log.info(
+        "deploy canary: generation %d burned its SLO, rolled back to "
+        "generation %d content; watchdog fired %d cleared %d (==1 "
+        "each required)",
+        canary["candidate_generation"], canary["rollback_generation"],
+        canary["watchdog_fired"], canary["watchdog_cleared"],
+    )
+    chaos = _deploy_chaos_section(
+        toy, maxlen, vocab, num_slots=num_slots, seed=seed + 57,
+    )
+    log.info(
+        "deploy chaos: shard killed mid-publication; %d replicas "
+        "converged on generation %d with %d double-applies "
+        "(%d wire-error skips, %d mixed-cut skips)",
+        chaos["replicas"], chaos["final_generation"],
+        chaos["double_applies"], chaos["wire_error_skips"],
+        chaos["mixed_cut_skips"],
+    )
+    migration = _deploy_migration_section(
+        toy, maxlen, vocab, seed=seed + 59,
+    )
+    log.info(
+        "deploy migration: generation-5 warm record refused by a "
+        "generation-7 engine, accepted after re-stamping (%d tokens)",
+        migration["matched_import_tokens"],
+    )
+    return {
+        "metric": (
+            "p99 during live weight pushes vs steady state "
+            "(deploy, cpu)"
+        ),
+        "value": livepush["p99_ratio"],
+        "unit": "x steady-state p99 (<=5x gated)",
+        "vs_baseline": livepush["p99_ratio"],
+        "livepush": livepush,
+        "canary": canary,
+        "chaos": chaos,
+        "migration": migration,
+    }
+
+
 def _pp_bubblefill_section(model, generate, rounds: int = 5):
     """The ``--preset pp`` ``bubblefill`` section (ISSUE 16): mid-flight
     long-prompt TTFT with bubble-filling chunked prefill vs the
@@ -3642,7 +4225,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset",
                    choices=["auto", "full", "tiny", "serving", "ps",
-                            "faults", "fleet", "pp"],
+                            "faults", "fleet", "pp", "deploy"],
                    default="auto",
                    help="serving = the continuous-batching engine bench "
                         "(aggregate tok/s, per-request p50/p99 latency, "
@@ -3655,7 +4238,11 @@ def main():
                         "fleet bench (router goodput at 2x one-replica "
                         "saturation, cache-aware vs round-robin "
                         "placement, replica-kill chaos with zero double "
-                        "tokens)")
+                        "tokens); deploy = the train-while-serving "
+                        "bench (p99 during live weight pushes, canary "
+                        "slo_burn auto-rollback, shard-kill deployment "
+                        "convergence, cross-generation migration "
+                        "refusal)")
     p.add_argument("--faults-seed", type=int, default=0,
                    help="faults preset: fault-plan seed (same seed = "
                         "same kill point, duplicates, delays)")
@@ -3700,6 +4287,11 @@ def main():
                         "replica's slots can admit)")
     p.add_argument("--fleet-slots", type=int, default=4,
                    help="fleet preset: KV slots per replica")
+    p.add_argument("--deploy-requests", type=int, default=12,
+                   help="deploy preset: closed-loop requests per arm "
+                        "of the live-push p99 comparison")
+    p.add_argument("--deploy-slots", type=int, default=4,
+                   help="deploy preset: KV slots per engine")
     p.add_argument("--pp-requests", type=int, default=24,
                    help="pp preset: requests in the workload (sized "
                         "past the TP-only arm's admission depth so "
@@ -3822,6 +4414,22 @@ def main():
             )
         except ImplausibleTiming as e:
             log.error("fleet bench implausible: %s — no JSON", e)
+            sys.exit(1)
+        emit_json(out)
+        return
+
+    if args.preset == "deploy":
+        # in-process engines + loopback shard sockets — like ps/faults/
+        # fleet, no mesh and no TPU probe; the gated sections refuse
+        # JSON on any miss
+        try:
+            out = measure_deploy(
+                max(6, args.deploy_requests),
+                max(1, args.deploy_slots),
+                args.faults_seed,
+            )
+        except ImplausibleTiming as e:
+            log.error("deploy bench implausible: %s — no JSON", e)
             sys.exit(1)
         emit_json(out)
         return

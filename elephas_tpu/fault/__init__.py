@@ -25,6 +25,7 @@ from elephas_tpu.fault.plan import (  # noqa: F401
     use_plan,
 )
 from elephas_tpu.fault.harness import (  # noqa: F401
+    DeployChaosStore,
     PSKiller,
     ReplicaKiller,
     RestartablePS,

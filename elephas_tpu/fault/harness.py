@@ -559,6 +559,64 @@ class ShardedRestartablePS:
                 self.servers[i] = None
 
 
+class DeployChaosStore:
+    """Ledger-facing store view over a :class:`ShardedRestartablePS`
+    (ISSUE 20): lets a
+    :class:`~elephas_tpu.deploy.versions.VersionLedger` publish weight
+    generations THROUGH the chaos harness, so a shard can be
+    crash-killed mid-deployment.
+
+    Semantics under a kill: a dead shard simply MISSES the publication
+    (weights are state, not a sequenced delta — there is nothing to
+    park and replay). After its journal restore it reports the
+    generation it last journaled, the store shows a MIXED version cut
+    (which every :class:`~elephas_tpu.deploy.subscriber.WeightSubscriber`
+    refuses to apply — serving never tears), and the NEXT publication
+    re-converges every shard. The subscriber's version compare makes
+    that convergence idempotent: one apply per generation, never two.
+    """
+
+    def __init__(self, harness: ShardedRestartablePS):
+        self.harness = harness
+
+    @property
+    def servers(self) -> list:
+        """Live shard servers — the unit the ledger journals at. Dead
+        shards are absent (their journal was written at the last
+        publication they saw; re-snapshotting a corpse is meaningless)."""
+        return [s for s in self.harness.servers if s is not None]
+
+    def set_weights(self, weights, weight_version: int | None = None):
+        """Scatter one generation onto every LIVE shard. Dead shards
+        are skipped loudly — they rejoin at an older generation and the
+        mixed cut is visible on ``status()`` until re-published."""
+        slices = self.harness.shard_map.scatter(
+            [np.asarray(w) for w in weights]
+        )
+        dead = [
+            i for i, s in enumerate(self.harness.servers) if s is None
+        ]
+        if dead:
+            logger.warning(
+                "deploy chaos: publishing generation %s past dead "
+                "shard(s) %s — they rejoin on an older generation "
+                "until the next publication", weight_version, dead,
+            )
+        for server, piece in zip(self.harness.servers, slices):
+            if server is not None:
+                server.set_weights(piece, weight_version=weight_version)
+
+    def get_parameters(self):
+        return self.harness.get_parameters()
+
+    def status(self) -> list[dict]:
+        """Per-LIVE-shard status, shard order (dead shards absent —
+        the wire-facing unreachability story belongs to the clients)."""
+        return [
+            s.status() for s in self.harness.servers if s is not None
+        ]
+
+
 class ShardKiller(threading.Thread):
     """Kills ONE shard once it has applied ``after_updates`` more
     updates (beyond ``baseline``), then waits for its recovery —

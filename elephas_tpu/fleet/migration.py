@@ -28,15 +28,25 @@ scales), and the header gains ``kv_dtype`` so an importer can refuse
 a dtype its arena doesn't speak BEFORE touching bytes. Quantized rows
 cross the wire as their stored bytes — the whole point: the record is
 ~4x (int8) / ~7x (int4) smaller than the fp equivalent, and the
-round-trip is still bitwise within the dtype. Encoding always emits
-v2; **legacy v1 fp records remain importable** (they decode to the
-same payload shape with ``kv_dtype="fp"``), and any other version is
-refused loudly — a torn or version-skewed migration must never resume
-as silent garbage.
+round-trip is still bitwise within the dtype. **Legacy v1 fp records
+remain importable** (they decode to the same payload shape with
+``kv_dtype="fp"``), and any unknown version is refused loudly — a
+torn or version-skewed migration must never resume as silent
+garbage.
 
 Cold records (``n_blocks == 0``) carry no arrays: the target replica
 re-prefills from the prompt — the right shape for requests that were
 still waiting or mid-prefill when exported.
+
+**v3 (ISSUE 20, continuous deployment)** adds ``weight_ver`` to the
+header: the weight generation the exporter's K/V was computed under.
+Warm rows from generation N are garbage under N+1 — the importer
+refuses mismatched **non-zero** generations loudly instead of
+resuming silent nonsense. ``0`` means "unversioned / cannot verify"
+(the shard-identity idiom), which is exactly what legacy v1/v2
+records decode to — so pre-deployment fleets keep migrating
+unchanged, and the check only bites once BOTH sides actually stamp
+generations. No layout change: v3 is v2 plus one header field.
 """
 
 from __future__ import annotations
@@ -49,7 +59,7 @@ import numpy as np
 __all__ = ["MAGIC", "VERSION", "encode_record", "decode_record"]
 
 MAGIC = b"EMIG"
-VERSION = 2
+VERSION = 3
 
 _HEAD = struct.Struct("<HI")  # version, header length
 
@@ -68,7 +78,7 @@ def _np_dtype(name: str) -> np.dtype:
 def encode_record(record: dict) -> bytes:
     """Serialize one engine export payload (the dict
     :meth:`~elephas_tpu.serving.engine.InferenceEngine.export_request`
-    returns) into the v2 wire format. Per-layer rows may be any tuple
+    returns) into the v3 wire format. Per-layer rows may be any tuple
     of arrays — fp ``(k, v)`` pairs or quantized ``(kq, vq, k_scale,
     v_scale)`` 4-tuples — and travel at their STORED dtype."""
     rows = record.get("rows") or {}
@@ -87,6 +97,10 @@ def encode_record(record: dict) -> bytes:
     header = {key: val for key, val in record.items() if key != "rows"}
     header["version"] = VERSION
     header.setdefault("kv_dtype", "fp")
+    # v3: records from pre-versioned exporters travel as generation 0
+    # ("cannot verify") rather than omitting the field — one uniform
+    # shape for the importer's mismatch check
+    header.setdefault("weight_ver", 0)
     header["layers"] = layers
     hb = json.dumps(header).encode("utf-8")
     out = bytearray(MAGIC)
@@ -110,7 +124,7 @@ def _layer_array_specs(version: int, spec: dict) -> list[dict]:
 
 
 def decode_record(data) -> dict:
-    """Parse wire bytes (v2, or legacy v1 fp) back into the engine's
+    """Parse wire bytes (v3, or legacy v1/v2) back into the engine's
     import payload shape. Raises ``ValueError`` loudly on a bad magic,
     unknown version, or truncated/oversized array section — a torn
     migration must never resume as silent garbage. v1 records come
@@ -122,7 +136,7 @@ def decode_record(data) -> dict:
             "not a migration record (bad magic — expected EMIG)"
         )
     version, hlen = _HEAD.unpack_from(mv, 4)
-    if version not in (1, VERSION):
+    if version not in (1, 2, VERSION):
         raise ValueError(
             f"migration record version {version} unsupported (this "
             f"codec speaks v1..v{VERSION})"
@@ -161,5 +175,8 @@ def decode_record(data) -> dict:
             f"bytes — torn write or mismatched header"
         )
     header.setdefault("kv_dtype", "fp")
+    # legacy v1/v2 records carry no generation — decode to 0 so the
+    # importer's non-zero mismatch check passes them through unchanged
+    header.setdefault("weight_ver", 0)
     header["rows"] = rows
     return header

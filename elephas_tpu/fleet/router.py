@@ -283,6 +283,15 @@ class Router:
         self._view: dict = {}
         self._placements_since_poll = self.poll_every  # poll on first
         self._draining: set[str] = set()
+        # canary split (ISSUE 20): replicas running the NEXT weight
+        # generation plus the traffic share routed to them. The split
+        # is a deterministic counter walk (int(seq*share) increments),
+        # not a random draw — same submit sequence, same canary
+        # assignment, on every process (and no wall clock / RNG in a
+        # placement decision, per the standing contract)
+        self._canary: set[str] = set()
+        self._canary_share = 0.0
+        self._canary_seq = 0
         # token bookkeeping: LEAF lock — taken from driver threads'
         # on_token shims and from re-drive/drain; never held while
         # acquiring a replica lock
@@ -364,6 +373,13 @@ class Router:
             "Replica drains completed",
             labels=("router",),
         ).labels(router=rid_label)
+        self._g_canary_share = reg.gauge(
+            "elephas_router_canary_share",
+            "Traffic share routed to the canary replica pool (0 = no "
+            "canary active)",
+            labels=("router",),
+        ).labels(router=rid_label)
+        self._g_canary_share.set(0.0)
         self._mf_up = reg.gauge(
             "elephas_router_replica_up",
             "1 while the router considers the replica alive (the "
@@ -454,41 +470,129 @@ class Router:
 
     def _place(self, prompt, exclude=()) -> PlacementDecision:
         """One placement decision: probe + rank under the placement
-        lock (the rr cursor and stale counter are shared state)."""
+        lock (the rr cursor and stale counter are shared state).
+
+        With a canary active (ISSUE 20), the fleet first splits into
+        canary / stable pools and the deterministic counter walk picks
+        which pool serves this request; the normal two-stage placement
+        then runs WITHIN the pool. Placements into the canary pool are
+        counted (and traced) as kind ``"canary"``. If either pool has
+        no live member the split is skipped — a dead canary must not
+        take the whole fleet down with it."""
         names = self._alive_names(exclude)
         if not names:
             raise RuntimeError(
                 "no live replica to place on — the fleet is down"
             )
+        canary_pick = False
+        with self._lock:
+            if self._canary and self._canary_share > 0.0:
+                cpool = [n for n in names if n in self._canary]
+                spool = [n for n in names if n not in self._canary]
+                if cpool and spool:
+                    self._canary_seq += 1
+                    seq, share = self._canary_seq, self._canary_share
+                    canary_pick = (
+                        int(seq * share) != int((seq - 1) * share)
+                    )
+                    names = cpool if canary_pick else spool
         if self.placement == "round_robin":
             # the bench's control arm: placement ignores warmth and
             # load entirely (counted as its own kind, not as stale)
             with self._lock:
                 pick = names[self._rr % len(names)]
                 self._rr += 1
-            return PlacementDecision(pick, "round_robin")
-        if len(names) == 1:
-            return PlacementDecision(names[0], "load")
-        probes = {
-            name: (
-                self.replicas[name].probe(prompt)
-                if self.placement == "affinity" else 0
-            )
-            for name in names
-        }
-        with self._lock:
-            decision = place(
-                probes, self._view, self.min_affinity_tokens, self._rr
-            )
-            self._placements_since_poll += 1
-            need_poll = self._placements_since_poll >= self.poll_every
-            if decision.kind == "round_robin":
-                # degraded floor: the whole view was stale
-                self._rr += 1
-                self._m_stale.inc()
-        if need_poll:
-            self.refresh_view()
+            decision = PlacementDecision(pick, "round_robin")
+        elif len(names) == 1:
+            decision = PlacementDecision(names[0], "load")
+        else:
+            probes = {
+                name: (
+                    self.replicas[name].probe(prompt)
+                    if self.placement == "affinity" else 0
+                )
+                for name in names
+            }
+            with self._lock:
+                decision = place(
+                    probes, self._view, self.min_affinity_tokens,
+                    self._rr,
+                )
+                self._placements_since_poll += 1
+                need_poll = (
+                    self._placements_since_poll >= self.poll_every
+                )
+                if decision.kind == "round_robin":
+                    # degraded floor: the whole view was stale
+                    self._rr += 1
+                    self._m_stale.inc()
+            if need_poll:
+                self.refresh_view()
+        if canary_pick:
+            decision = PlacementDecision(decision.replica, "canary")
         return decision
+
+    # -- canary (ISSUE 20) ----------------------------------------------
+
+    def set_canary(self, names, share: float) -> None:
+        """Route ``share`` (0..1) of subsequent placements to the
+        ``names`` replica pool (the replicas serving the candidate
+        weight generation). Validates loudly: unknown replicas and a
+        canary pool that swallows the whole fleet are configuration
+        bugs, not conditions to limp through. Replaces any previous
+        canary; the deterministic split counter restarts."""
+        if isinstance(names, str):
+            names = [names]
+        names = {str(n) for n in names}
+        if not names:
+            raise ValueError("a canary needs at least one replica")
+        unknown = names - set(self.replicas)
+        if unknown:
+            raise ValueError(
+                f"canary names {sorted(unknown)} are not replicas of "
+                f"this router (have {sorted(self.replicas)})"
+            )
+        if not names < set(self.replicas):
+            raise ValueError(
+                "canary pool covers every replica — there would be no "
+                "stable pool to roll back to"
+            )
+        share = float(share)
+        if not 0.0 < share <= 1.0:
+            raise ValueError(
+                f"canary share must be in (0, 1], got {share}"
+            )
+        with self._lock:
+            self._canary = names
+            self._canary_share = share
+            self._canary_seq = 0
+        self._g_canary_share.set(share)
+        self._tracer.emit(
+            "router.canary", router=self.telemetry_label,
+            replicas=",".join(sorted(names)), share=share,
+        )
+
+    def clear_canary(self) -> None:
+        """End the canary split (promotion or rollback both land
+        here): every placement sees the full fleet again."""
+        with self._lock:
+            self._canary = set()
+            self._canary_share = 0.0
+            self._canary_seq = 0
+        self._g_canary_share.set(0.0)
+        self._tracer.emit(
+            "router.canary", router=self.telemetry_label,
+            replicas="", share=0.0,
+        )
+
+    def canary_status(self) -> dict:
+        """The live canary split, for supervisors and tests."""
+        with self._lock:
+            return {
+                "replicas": sorted(self._canary),
+                "share": self._canary_share,
+                "placements_seen": self._canary_seq,
+            }
 
     # -- submission -----------------------------------------------------
 

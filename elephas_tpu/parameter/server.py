@@ -160,6 +160,12 @@ class BaseParameterServer:
         self.shard_signature = shard_signature
         self.lock = threading.Lock()
         self.weights = [np.asarray(w) for w in weights]
+        # monotonic weight generation (ISSUE 20): 0 = "unversioned"
+        # (training deltas mutate in place without minting); the deploy
+        # ledger stamps a new generation on each publication via
+        # set_weights(weight_version=...) and the journal carries it so
+        # a restore knows which generation it resumed
+        self.weight_version = 0
         self._started = False
         self._dense_codec = wire.WireCodec()
         self._int8_codec = wire.WireCodec(compression="int8")
@@ -227,6 +233,13 @@ class BaseParameterServer:
             ).set(1)
         # pull-time gauges: lag/staleness change with time, not events
         reg.gauge(
+            "elephas_ps_weight_version",
+            "Weight generation currently served (0 = unversioned)",
+            labels=("server",),
+        ).labels(server=sid).set_function(_weak_gauge_fn(
+            self, lambda s: s.weight_version
+        ))
+        reg.gauge(
             "elephas_ps_journal_lag_updates",
             "Applied updates not yet covered by a journal snapshot",
             labels=("server",),
@@ -289,6 +302,10 @@ class BaseParameterServer:
             )
         self.weights = restored
         self.seq_table = seq_table
+        # restore resumes the journaled generation — a restarted shard
+        # must not re-serve generation N while claiming version 0, or
+        # the deploy subscriber would re-apply N as if it were new
+        self.weight_version = int(meta.get("weight_version", 0))
         self.restored_from_journal = True
         logger.info(
             "parameter server restored from journal %s (%d client "
@@ -395,9 +412,14 @@ class BaseParameterServer:
             span.set(applied=True)
             return True
 
-    def set_weights(self, weights) -> None:
+    def set_weights(self, weights, weight_version: int | None = None) -> None:
+        """Replace the full weight list, optionally stamping the
+        generation (ISSUE 20 ledger publication). Unstamped callers
+        (training-side full syncs) leave the version untouched."""
         with self.lock:
             self.weights = [np.asarray(w) for w in weights]
+            if weight_version is not None:
+                self.weight_version = int(weight_version)
 
     def encode_parameters(self, compression: str = "none"):
         """Current weights as codec frames (the binary get path)."""
@@ -453,6 +475,7 @@ class BaseParameterServer:
             "protocol_version": PROTOCOL_VERSION,
             "mode": self.mode,
             **shard,
+            "weight_version": self.weight_version,
             "uptime_s": round(time.monotonic() - self._created_at, 3),
             "updates_applied": self.updates_applied,
             "updates_duplicate": self.updates_duplicate,
@@ -523,6 +546,7 @@ class BaseParameterServer:
                 seq_table = dict(self.seq_table)
                 weights = self.get_parameters()
                 applied = self._applied_seen
+                weight_version = self.weight_version
             path = journal_io.save_journal(
                 self.journal_dir,
                 weights,
@@ -530,6 +554,7 @@ class BaseParameterServer:
                 meta={
                     "mode": self.mode,
                     "updates_applied": applied,
+                    "weight_version": weight_version,
                 },
             )
             self._m_journal_writes.inc()

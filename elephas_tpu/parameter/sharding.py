@@ -253,11 +253,11 @@ class ShardedServerGroup:
         for s in self.servers:
             s.stop(flush_journal=flush_journal)
 
-    def set_weights(self, weights) -> None:
+    def set_weights(self, weights, weight_version: int | None = None) -> None:
         for server, part in zip(
             self.servers, self.shard_map.scatter(list(weights))
         ):
-            server.set_weights(part)
+            server.set_weights(part, weight_version=weight_version)
 
     def get_parameters(self) -> list[np.ndarray]:
         return self.shard_map.gather(

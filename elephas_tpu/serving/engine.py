@@ -798,6 +798,17 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "constant 1, dtype name in the label)",
             labels=("engine", "kv_dtype"),
         ).labels(engine=eid, kv_dtype=self.kv_dtype).set(1)
+        # weight generation (ISSUE 20): plain value gauge (not an info
+        # gauge — generations are ordered and dashboards graph the
+        # fleet converging), re-set by every stamped refresh_weights()
+        self.weight_version = 0
+        self._g_weight_version = treg.gauge(
+            "elephas_serving_weight_version",
+            "Weight generation the engine currently serves "
+            "(0 = unversioned; stamped by refresh_weights(version=))",
+            labels=("engine",),
+        ).labels(engine=eid)
+        self._g_weight_version.set(self.weight_version)
         # per-bucket prefill-token histogram (ISSUE 11): one observation
         # per completed prefill, labeled by the compiled bucket it ran
         # through — Chrome traces say WHERE long prompts spend TTFT,
@@ -1352,10 +1363,12 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
 
         return host_read(leaf, self.mesh)
 
-    def refresh_weights(self) -> None:
+    def refresh_weights(self, version: int | None = None) -> None:
         """(Re-)upload the model's weights — call after further
         training; the compiled programs take them as arguments, so no
-        recompile happens.
+        recompile happens. ``version`` stamps the new weight
+        generation (ISSUE 20 deploy subscriber); ``None`` keeps the
+        current stamp (ad-hoc in-place refresh, pre-versioned callers).
 
         Flushes the prefix cache: resident donor K/V was computed
         under the OLD weights, and a donor copy would silently splice
@@ -1365,6 +1378,11 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         documented behavior as refreshing mid-decode.)"""
         import jax.numpy as jnp
 
+        if version is not None:
+            self.weight_version = int(version)
+        elif not hasattr(self, "weight_version"):
+            # constructor's first call, before any attribute setup
+            self.weight_version = 0
         # lifecycle event (ISSUE 13): a weight push travelling
         # worker → PS → engine ends HERE — emitting under the caller's
         # trace scope stamps the same trace id the push carried, so
@@ -1375,7 +1393,11 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         if tracer is not None:
             tracer.emit(
                 "serve.refresh_weights", engine=self.telemetry_label,
+                weight_version=self.weight_version,
             )
+        gauge = getattr(self, "_g_weight_version", None)
+        if gauge is not None:
+            gauge.set(self.weight_version)
         # guarded for the constructor's first call (scheduler not
         # built yet — nothing cached before weights exist)
         scheduler = getattr(self, "scheduler", None)
@@ -1394,6 +1416,10 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         drafter = getattr(self, "_drafter", None)
         if drafter is not None:
             drafter.refresh_weights()
+            # the draft model now serves the SAME generation as the
+            # target — without the stamp a mixed-version fleet debug
+            # view would show the drafter forever at generation 0
+            drafter.weight_version = self.weight_version
         # SP prefill keeps its own mesh-replicated weight staging —
         # drop it so the next long prompt re-stages the new weights
         self._sp_weights = None
@@ -1661,6 +1687,10 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "ttft_deadline_ms": req.ttft_deadline_ms,
             "submit_step": req.submit_step,
             "submit_seq": -1,  # set from the serve.submit instant
+            # generation at submit: a mixed-version fleet is diagnosed
+            # from traces — a request whose record says N running on a
+            # replica that reports N+1 straddled a deployment
+            "weight_version": self.weight_version,
             "verdict": None,
             # first-admission mirrors (the fields explain() names);
             # `admissions` keeps every entry (resume re-admissions)
@@ -3078,8 +3108,12 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             # (fp pairs, or quantized code+scale 4-tuples), declared
             # by kv_dtype so an importer can refuse a mismatch before
             # touching array bytes; v1 records remain importable
-            "version": 2,
+            # v3 (ISSUE 20): weight_ver declares the K/V's generation —
+            # warm rows computed under generation N are garbage under
+            # N+1, so the importer refuses a non-zero mismatch loudly
+            "version": 3,
             "kv_dtype": self.kv_dtype,
+            "weight_ver": self.weight_version,
             "rid": int(req.rid),
             "prompt": [int(t) for t in req.prompt],
             "tokens": [int(t) for t in req.tokens],
@@ -3121,11 +3155,11 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
         arenas storing the same dtype — v1/fp records refuse into a
         quantized arena and vice versa), and the ``cur_len == prompt
         + generated - 1`` resume invariant."""
-        if int(record.get("version", -1)) not in (1, 2):
+        if int(record.get("version", -1)) not in (1, 2, 3):
             raise ValueError(
                 f"unknown migration record version "
                 f"{record.get('version')!r} (this engine speaks "
-                f"v1..v2)"
+                f"v1..v3)"
             )
         sched = self.scheduler
         rid = int(record["rid"])
@@ -3195,6 +3229,22 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
                     f"{self.kv_dtype!r} — quantized KV blocks are "
                     f"bit-portable only between arenas storing the "
                     f"same dtype (re-drive the request cold instead)"
+                )
+            # weight generation (ISSUE 20, v3): warm rows computed
+            # under generation N are garbage under N+1 — resuming them
+            # would silently break bit-exactness, the exact failure
+            # this field exists to catch. 0 means "unversioned /
+            # legacy record, cannot verify" (the shard-identity idiom):
+            # refusal needs BOTH sides to claim a generation.
+            rec_wver = int(record.get("weight_ver", 0))
+            if rec_wver and self.weight_version and (
+                rec_wver != self.weight_version
+            ):
+                raise ValueError(
+                    f"record weight_ver {rec_wver} != this engine's "
+                    f"weight_version {self.weight_version} — warm K/V "
+                    f"from another weight generation cannot resume "
+                    f"bit-exact (re-drive the request cold instead)"
                 )
             arity = 2 if self.kv_dtype == "fp" else 4
             bad_arity = {
@@ -3418,6 +3468,7 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "num_slots": self.num_slots,
             "attention": self.attention,
             "kv_dtype": self.kv_dtype,
+            "weight_version": self.weight_version,
             # the BENCH_r05 lesson at the serving surface: if backend
             # discovery fell back to CPU, say so HERE, not only in
             # bench JSON
@@ -3644,6 +3695,9 @@ AdmissionRejected` at submit), policy-derived preemption priority, and
             "kv_quant_offload_bytes": int(self._m_offload_bytes.value),
             "kv_quant_export_bytes": int(self._m_export_bytes.value),
             "score_requests": int(self._m_score_requests.value),
+            # continuous deployment (ISSUE 20): the generation this
+            # engine serves — same truth the weight_version gauge holds
+            "weight_version": self.weight_version,
         }
         if self.policy is not None:
             out["policy"] = self.policy.stats()

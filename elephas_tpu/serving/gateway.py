@@ -832,7 +832,13 @@ class Gateway:
         and whether the K/V resumed warm. No token stream re-attaches
         over this route (callbacks never travel) — the in-process
         fleet router re-wires streams itself; a wire-migrated request
-        accumulates tokens readable via its trace/stats surfaces."""
+        accumulates tokens readable via its trace/stats surfaces.
+
+        Passthrough validation (ISSUE 20): the record's ``weight_ver``
+        rides the decoded header into ``import_request``, whose
+        generation-mismatch refusal surfaces here as 409 — a warm
+        record from another weight generation must never resume as
+        silent garbage over the wire either."""
         from elephas_tpu.fleet.migration import decode_record
 
         loop = asyncio.get_running_loop()
@@ -932,6 +938,11 @@ class Gateway:
             "steps": steps,
             "queue_has_work": has_work,
             "driver_alive": alive,
+            # ISSUE 20: the weight generation this replica serves — a
+            # GIL-atomic int read, so a mixed-version fleet is visible
+            # from health probes alone (report-only, never flips the
+            # verdict: an old generation is stale, not dead)
+            "weight_version": self.engine.weight_version,
             # ISSUE 19 satellite: if jax backend discovery fell back
             # to CPU (the BENCH_r05 driver-box TPU init crash), every
             # health probe says so — report-only, never flips the

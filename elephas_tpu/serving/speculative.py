@@ -71,6 +71,12 @@ class Drafter:
     discards it); a missing guess costs nothing (the slot rides the
     verify round as a plain one-token decode)."""
 
+    # weight generation this drafter's state was built under (ISSUE
+    # 20): the engine's refresh_weights() cascade stamps it alongside
+    # the re-upload, so a mixed-version fleet debug view can tell a
+    # stale draft model from a refreshed one. 0 = unversioned.
+    weight_version: int = 0
+
     def propose(self, req, k: int) -> list[int]:
         """Up to ``k`` guessed continuation tokens for ``req`` (which
         exposes ``prompt``, ``tokens`` and ``full_sequence``). Return

@@ -465,6 +465,13 @@ class FleetScraper:
                 "requests_finished": total(
                     "elephas_serving_requests_finished_total"
                 ),
+                # ISSUE 20: the weight generation each instance serves
+                # — the rollout controller's convergence read (one
+                # gauge per engine, so a multi-engine instance sums;
+                # fleet replicas are one engine each)
+                "weight_version": int(total(
+                    "elephas_serving_weight_version"
+                )),
             }
         return out
 

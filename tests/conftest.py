@@ -50,6 +50,7 @@ _PS_DEADLINE_MODULES = (
     "test_telemetry",
     "test_telemetry_fleet",
     "test_fleet",
+    "test_deploy",
 )
 PS_TEST_DEADLINE_S = 120
 

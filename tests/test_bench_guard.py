@@ -222,6 +222,49 @@ class TestShardedFaultsBenchContract:
         assert not any(rec["pending_final"])
 
 
+@pytest.mark.slow  # engines + loopback shard sockets in a subprocess
+class TestDeployBenchContract:
+    def test_deploy_preset_emits_sane_record(self):
+        """`bench.py --preset deploy` (ISSUE 20): one JSON line proving
+        the train-while-serving acceptance criteria — p99 during live
+        weight pushes within the bounded factor of steady state (and
+        token-exact), the canary cycle auto-rolled-back off a real
+        slo_burn with exactly one fired and one cleared anomaly, the
+        mid-deployment shard kill converged every replica on one
+        generation with zero double-applies, and the cross-generation
+        warm migration refused loudly."""
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                   KERAS_BACKEND="jax")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--preset", "deploy", "--deploy-requests", "8"],
+            capture_output=True, text=True, timeout=900, env=env, cwd=repo,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert {"metric", "value", "unit", "vs_baseline", "livepush",
+                "canary", "chaos", "migration"} <= set(rec)
+        assert 0 < rec["livepush"]["p99_ratio"] <= 5.0
+        assert rec["livepush"]["token_exact"] is True
+        assert rec["livepush"]["generations_applied"] == \
+            rec["livepush"]["pushes"]
+        assert rec["canary"]["watchdog_fired"] == 1
+        assert rec["canary"]["watchdog_cleared"] == 1
+        assert rec["canary"]["outcome"] == "rolled_back"
+        assert rec["canary"]["rollback_generation"] > \
+            rec["canary"]["candidate_generation"]  # monotonic ledger
+        assert rec["chaos"]["double_applies"] == 0
+        assert rec["chaos"]["converged_versions"] == \
+            [rec["chaos"]["final_generation"]]
+        assert rec["chaos"]["wire_error_skips"] >= 1
+        assert rec["chaos"]["mixed_cut_skips"] >= 1
+        assert rec["migration"]["mismatch_refused"] is True
+
+
 class TestFaultPathLint:
     """ISSUE 3 satellite (extended to the serving vertical in ISSUE 4):
     the fault/recovery paths — and the serving engine, whose slot/
@@ -245,7 +288,7 @@ class TestFaultPathLint:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         files = [os.path.join(root, "elephas_tpu", "utils", "sockets.py")]
         for pkg in ("parameter", "fault", "serving", "telemetry",
-                    "fleet"):
+                    "fleet", "deploy"):
             files.extend(
                 sorted(glob.glob(
                     os.path.join(root, "elephas_tpu", pkg, "*.py")
@@ -360,6 +403,18 @@ class TestFaultPathLint:
             f.endswith(os.path.join("serving", "kv_quant.py"))
             for f in files
         )
+        # ISSUE 20: the continuous-deployment path IS a fault path —
+        # the subscriber's poll absorbs wire failures as counted skips
+        # by design, so an extra swallowed except there silently turns
+        # a torn pull into an applied one; the ledger journals every
+        # publication (an eaten journal error loses the generation a
+        # restarted shard restores into); the rollout controller's
+        # rollback IS the recovery action. Pinned by name so a rename
+        # cannot drop them out of the deploy glob.
+        for mod in ("versions.py", "subscriber.py", "rollout.py"):
+            assert any(
+                f.endswith(os.path.join("deploy", mod)) for f in files
+            ), mod
         return root, files
 
     def test_no_bare_or_swallowed_excepts_on_fault_paths(self):
@@ -519,12 +574,22 @@ class TestTelemetryWallClockLint:
         # ISSUE 14: the fleet router's placement/re-drive decisions
         # are deterministic by contract — wall clock there would fork
         # what identical processes derive from identical snapshots
-        for pkg in ("parameter", "fault", "serving", "fleet"):
+        for pkg in ("parameter", "fault", "serving", "fleet",
+                    "deploy"):
             files.extend(
                 sorted(glob.glob(
                     os.path.join(root, "elephas_tpu", pkg, "*.py")
                 ))
             )
+        # ISSUE 20: deployment decisions (apply-or-skip, canary
+        # promote/rollback windows) run on version compares and
+        # evaluation counts by contract — wall clock in them would
+        # make replicas disagree about which generation to serve;
+        # pinned by name so a rename cannot drop them from the glob
+        for mod in ("versions.py", "subscriber.py", "rollout.py"):
+            assert any(
+                f.endswith(os.path.join("deploy", mod)) for f in files
+            ), mod
         assert any(
             f.endswith(os.path.join("fleet", "router.py"))
             for f in files
@@ -705,6 +770,19 @@ class TestTelemetryWallClockLint:
             f.endswith(os.path.join("serving", "prefix_cache.py"))
             for f in files
         )
+        # ISSUE 20: the deploy subsystem's emission sites (pull/apply
+        # counters, staleness gauge, canary outcome counters, ledger
+        # version gauge) record through attributes captured in
+        # __init__ like every serving module — a subscriber that
+        # re-resolved null mode per poll would fork what it was built
+        # to record; pinned by name
+        files.extend(sorted(glob.glob(
+            os.path.join(root, "elephas_tpu", "deploy", "*.py")
+        )))
+        for mod in ("versions.py", "subscriber.py", "rollout.py"):
+            assert any(
+                f.endswith(os.path.join("deploy", mod)) for f in files
+            ), mod
         offences = []
         for path in files:
             with open(path) as f:

@@ -403,6 +403,63 @@ def test_refresh_midway_through_chunked_prefill_never_donates(lm):
     )
 
 
+def test_versioned_refresh_midprefill_never_mixes_generations(lm):
+    """ISSUE 20 regression on the PR-4 quarantine: a versioned
+    ``refresh_weights(version=)`` mid-chunked-prefill must keep the
+    quarantine intact (the straddler finishes but never donates), and
+    the lifecycle records must pin which generation each request ran
+    under — the straddler keeps its SUBMIT-time stamp while the
+    engine (and any later request) reports the new one, so a mixed
+    record/engine pair is diagnosable instead of silent."""
+    from elephas_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(
+        lm, num_slots=2, prefix_cache=True, prefill_chunk=4,
+        flight_recorder=8,
+    )
+    engine.refresh_weights(version=1)
+    long_prompt = SHARED + SHARED + [2, 3, 4]  # 19 tokens, 5 chunks
+    r1 = engine.submit(long_prompt, 3)
+    engine.step()  # mid-prefill (4/19 tokens resident)
+    assert engine._prefilling
+    # same weight VALUES, new generation: the straddler now holds
+    # rows from "both" generations — the quarantine must hold exactly
+    # as it does for the unversioned refresh
+    engine.refresh_weights(version=2)
+    engine.run()
+    assert r1.done
+    cache = engine.scheduler.prefix_cache
+    assert cache.stats()["entries"] == 0  # straddler never inserted
+    assert engine.weight_version == 2
+    assert engine.explain(r1.rid)["weight_version"] == 1  # submit-time
+    r2 = engine.submit(SHARED, 3)
+    engine.run()
+    assert engine.explain(r2.rid)["weight_version"] == 2
+    assert cache.stats()["entries"] == 1  # post-refresh donor again
+    engine.release_telemetry()
+
+
+def test_versioned_refresh_cascades_to_draft_model(lm):
+    """ISSUE 20 satellite: ``refresh_weights(version=)`` on a
+    spec-decode engine re-stamps the DRAFT model too — without the
+    cascade a mixed-version fleet view would show the drafter forever
+    at generation 0 — and output stays token-exact afterwards."""
+    from elephas_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(
+        lm, num_slots=2, speculative=True, spec_k=3, spec_drafter=lm,
+    )
+    assert engine._drafter.weight_version == 0
+    engine.refresh_weights(version=3)
+    assert engine._drafter.weight_version == 3
+    out = engine.run([(SHARED + [4], 4)])
+    (tokens,) = out.values()
+    np.testing.assert_array_equal(
+        tokens, _one_shot(lm, SHARED + [4], 4)
+    )
+    engine.release_telemetry()
+
+
 def test_prefix_min_reuse_floor_admits_shallow_matches_cold(lm):
     """prefix_min_reuse: a 1-2 token coincidental prefix is not worth
     a copy dispatch — below the floor the request admits cold (and is

@@ -279,6 +279,7 @@ def test_debug_engine_and_healthz(traced):
                 "blocks_total", "blocks_free", "prefix_index"):
         assert key in snap, key
     assert snap["engine"] == engine.telemetry_label
+    assert snap["weight_version"] == engine.weight_version  # ISSUE 20
     assert snap["policy"]["name"] == "FairSharePolicy"
     assert snap["flight_recorder"]["capacity"] == 16
     assert snap["blocks_total"] == 8
@@ -290,6 +291,7 @@ def test_debug_engine_and_healthz(traced):
     assert resp.status == 200
     hz = json.loads(raw)
     assert hz["status"] == "ok" and hz["driver_alive"] is True
+    assert hz["weight_version"] == engine.weight_version  # ISSUE 20
 
     # a stalled engine reports 503: pretend work exists and steps
     # froze by shrinking the grace window below zero. The injected
